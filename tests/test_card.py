@@ -1,0 +1,74 @@
+"""Cardinality (SDF-rate) analysis tests."""
+
+from ziria_tpu import take, takes, emit1, emits, ret, seq, let, zmap, repeat, pipe
+from ziria_tpu.core import ir
+from ziria_tpu.core.card import CCard, TCard, DYN, cardinality, steady_state
+
+
+def test_basic_computers():
+    assert cardinality(take) == CCard(1, 0)
+    assert cardinality(takes(5)) == CCard(5, 0)
+    assert cardinality(emit1(1)) == CCard(0, 1)
+    assert cardinality(emits([1, 2], 2)) == CCard(0, 2)
+    assert cardinality(ret(0)) == CCard(0, 0)
+
+
+def test_bind_sums():
+    c = let("x", takes(3), emits(lambda env: env["x"], 3))
+    assert cardinality(c) == CCard(3, 3)
+
+
+def test_repeat_gives_rate():
+    c = repeat(let("x", take, emit1(lambda env: env["x"])))
+    assert cardinality(c) == TCard(1, 1)
+
+
+def test_map_rate():
+    assert cardinality(zmap(lambda x: x, 4, 2)) == TCard(4, 2)
+
+
+def test_pipe_steady_state_rates():
+    # 1->3 then 2->1 : lcm(3,2)=6 -> up fires 2x, down 3x : rate 2 -> 3
+    c = pipe(zmap(lambda x: x, 1, 3), zmap(lambda x: x, 2, 1))
+    assert cardinality(c) == TCard(2, 3)
+
+
+def test_while_dynamic():
+    c = ir.While(lambda env: True, emit1(1))
+    assert cardinality(c) == DYN
+
+
+def test_for_static():
+    c = ir.For("i", 4, let("x", take, emit1(lambda env: env["x"])))
+    assert cardinality(c) == CCard(4, 4)
+
+
+def test_steady_state_plan():
+    stages = [zmap(lambda x: x, 1, 3), zmap(lambda x: x, 2, 1),
+              zmap(lambda x: x, 3, 3)]
+    ss = steady_state(stages)
+    # stage0 o=3, stage1 i=2 -> lcm 6: reps (2,3); stage1 out 3*1=3, stage2
+    # i=3 -> reps (2,3,1); consumes 2, emits 3
+    assert ss.reps == (2, 3, 1)
+    assert ss.take == 2
+    assert ss.emit == 3
+
+
+def test_steady_state_none_for_dynamic():
+    stages = [zmap(lambda x: x), ir.While(lambda env: True, emit1(1))]
+    assert steady_state(stages) is None
+
+
+def test_steady_state_none_for_interior_zero_rates():
+    sink = repeat(let("x", take, ret(0)))       # TCard(1, 0)
+    source = repeat(emit1(1))                   # TCard(0, 1)
+    f = zmap(lambda x: x)
+    assert steady_state([f, sink, f]) is None   # sink mid-chain
+    assert steady_state([f, source]) is None    # source downstream
+    # sink in last position and source in first position ARE plannable
+    assert steady_state([f, sink]) is not None
+    assert steady_state([source, f]) is not None
+
+
+def test_steady_state_empty():
+    assert steady_state([]) is None

@@ -1,0 +1,15 @@
+import numpy as np
+from ziria_tpu.utils.diff import stream_diff
+
+def test_bool_vs_float_symmetric():
+    a = np.array([True]); b = np.array([0.9])
+    r1 = stream_diff(a, b, atol=0.2)
+    r2 = stream_diff(b, a, atol=0.2)
+    assert bool(r1) == bool(r2)  # both tolerance path
+    assert r1.ok and r2.ok
+
+def test_bool_bool_exact():
+    assert not stream_diff(np.array([True]), np.array([False]), atol=9.0)
+
+def test_int_exact_despite_tolerance():
+    assert not stream_diff(np.array([1]), np.array([2]), atol=9.0)

@@ -1,0 +1,204 @@
+"""Interpreter semantics tests: the combinator laws of the stream level.
+
+These pin the oracle's behavior (take/emit/map/repeat/bind/pipe and the
+termination rules of `>>>`), mirroring the reference's language-level test
+group (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from ziria_tpu import (take, takes, emit1, emits, ret, seq, let, zmap,
+                       map_accum, repeat, pipe, par_pipe, for_loop,
+                       while_loop, branch)
+from ziria_tpu.core.ir import let_ref, assign
+from ziria_tpu.interp.interp import run
+from ziria_tpu.utils.diff import assert_stream_eq
+
+
+def test_take_returns_item():
+    r = run(take, [42, 7])
+    assert r.value == 42
+    assert r.outputs == []
+    assert r.consumed == 1
+    assert r.terminated_by == "computer"
+
+
+def test_takes_stacks():
+    r = run(takes(3), [1, 2, 3, 4])
+    assert_stream_eq(r.value, np.array([1, 2, 3]))
+
+
+def test_emit_value():
+    r = run(emit1(5), [])
+    assert r.outputs == [5]
+    assert r.value is None
+
+
+def test_emits_array():
+    r = run(emits(np.array([1, 2, 3]), 3), [])
+    assert [int(x) for x in r.outputs] == [1, 2, 3]
+
+
+def test_bind_passes_value():
+    c = let("x", take, emit1(lambda env: env["x"] * 10))
+    r = run(c, [7])
+    assert r.outputs == [70]
+
+
+def test_seq_discards():
+    c = seq(emit1(1), emit1(2), ret(99))
+    r = run(c, [])
+    assert r.outputs == [1, 2]
+    assert r.value == 99
+
+
+def test_map_doubles_forever_until_eof():
+    c = zmap(lambda x: x * 2)
+    r = run(c, [1, 2, 3])
+    assert [int(x) for x in r.outputs] == [2, 4, 6]
+    assert r.terminated_by == "eof"
+
+
+def test_map_chunked_arity():
+    # takes 2 items, emits their sum then difference (2 -> 2 chunk map)
+    c = zmap(lambda v: np.array([v[0] + v[1], v[0] - v[1]]),
+             in_arity=2, out_arity=2)
+    r = run(c, [5, 3, 10, 4])
+    assert [int(x) for x in r.outputs] == [8, 2, 14, 6]
+
+
+def test_map_accum_running_sum():
+    c = map_accum(lambda s, x: (s + x, s + x), 0)
+    r = run(c, [1, 2, 3, 4])
+    assert [int(x) for x in r.outputs] == [1, 3, 6, 10]
+
+
+def test_repeat_of_computer():
+    # repeat { x <- take; emit x+1 }
+    c = repeat(let("x", take, emit1(lambda env: env["x"] + 1)))
+    r = run(c, [10, 20, 30])
+    assert [int(x) for x in r.outputs] == [11, 21, 31]
+
+
+def test_pipe_transformers():
+    c = pipe(zmap(lambda x: x + 1), zmap(lambda x: x * 3))
+    r = run(c, [0, 1, 2])
+    assert [int(x) for x in r.outputs] == [3, 6, 9]
+
+
+def test_pipe_downstream_computer_terminates_first():
+    # infinite upstream, downstream takes 2 then returns their sum
+    c = pipe(zmap(lambda x: x * 2),
+             let("v", takes(2), ret(lambda env: env["v"].sum())))
+    r = run(c, [1, 2, 3, 4, 5])
+    assert r.value == 6  # (1*2) + (2*2)
+    assert r.terminated_by == "computer"
+    assert r.consumed == 2
+
+
+def test_pipe_upstream_computer_terminates_first():
+    # upstream emits 2 then returns "done"; downstream maps forever
+    up = seq(emit1(1), emit1(2), ret("done"))
+    c = pipe(up, zmap(lambda x: x + 100))
+    r = run(c, [])
+    assert [int(x) for x in r.outputs] == [101, 102]
+    assert r.value == "done"
+    # the pipe terminates *locally* with the upstream's value — a normal
+    # computer termination, not an EOF abort of the whole program
+    assert r.terminated_by == "computer"
+
+
+def test_bind_continues_after_pipe_upstream_terminates():
+    # v <- (emit 1; return 5) >>> map(+100) ; emit v*2
+    # The pipe terminates with 5; the enclosing bind must keep running.
+    c = let("v", pipe(seq(emit1(1), ret(5)), zmap(lambda x: x + 100)),
+            emit1(lambda env: env["v"] * 2))
+    r = run(c, [])
+    assert [int(x) for x in r.outputs] == [101, 10]
+    assert r.terminated_by == "computer"
+
+
+def test_outer_eof_still_propagates_through_nested_pipes():
+    c = pipe(zmap(lambda x: x + 1), pipe(zmap(lambda x: x * 2),
+                                         zmap(lambda x: x - 3)))
+    r = run(c, [1, 2])
+    assert [int(x) for x in r.outputs] == [1, 3]
+    assert r.terminated_by == "eof"
+
+
+def test_repeat_of_pure_computer_rejected():
+    with pytest.raises(ValueError, match="diverges"):
+        run(repeat(ret(0)), [], max_out=5)
+
+
+def test_assign_to_let_binding_rejected():
+    c = let("x", take, seq(assign("x", 99), emit1(lambda env: env["x"])))
+    with pytest.raises(KeyError, match="immutable let-binding"):
+        run(c, [1])
+
+
+def test_emits_scalar_rejected():
+    with pytest.raises(ValueError, match="emits"):
+        run(emits(5, 1), [])
+
+
+def test_par_pipe_identical_to_pipe():
+    # |>>>| must produce output identical to >>> (reference invariant)
+    a = pipe(zmap(lambda x: x + 1), zmap(lambda x: x * 3))
+    b = par_pipe(zmap(lambda x: x + 1), zmap(lambda x: x * 3))
+    xs = list(range(10))
+    assert_stream_eq(run(a, xs).out_array(), run(b, xs).out_array())
+
+
+def test_for_loop_emits():
+    c = for_loop(4, emit1(lambda env: env["i"] ** 2), var="i")
+    r = run(c, [])
+    assert [int(x) for x in r.outputs] == [0, 1, 4, 9]
+
+
+def test_while_with_ref():
+    # var n := 0; while n < 3 { emit n; n := n + 1 }
+    c = let_ref(
+        "n", 0,
+        while_loop(lambda env: env["n"] < 3,
+                   seq(emit1(lambda env: env["n"]),
+                       assign("n", lambda env: env["n"] + 1))))
+    r = run(c, [])
+    assert [int(x) for x in r.outputs] == [0, 1, 2]
+
+
+def test_branch():
+    c = let("x", take,
+            branch(lambda env: env["x"] > 0, emit1("pos"), emit1("neg")))
+    assert run(c, [5]).outputs == ["pos"]
+    assert run(c, [-5]).outputs == ["neg"]
+
+
+def test_rate_mismatch_pipe():
+    # up emits chunks of 3; down consumes chunks of 2 -> item streams still align
+    up = zmap(lambda v: v * 2, in_arity=3, out_arity=3)
+    down = zmap(lambda v: v.sum(), in_arity=2, out_arity=1)
+    r = run(pipe(up, down), [1, 2, 3, 4, 5, 6])
+    # doubled: 2,4,6,8,10,12 ; pairs: (2+4),(6+8),(10+12)
+    assert [int(x) for x in r.outputs] == [6, 14, 22]
+
+
+def test_max_out_limit():
+    c = repeat(emit1(1))
+    r = run(c, [], max_out=5)
+    assert len(r.outputs) == 5
+    assert r.terminated_by == "limit"
+
+
+def test_repeat_dynamic_pure_body_detected_at_runtime():
+    # For with a dynamic count of 0: cardinality is DYN, so only the
+    # runtime progress guard can catch the divergence.
+    c = repeat(for_loop(lambda env: 0, emit1(1)))
+    with pytest.raises(ValueError, match="no stream progress"):
+        run(c, [], max_out=1)
+
+
+def test_max_out_zero():
+    r = run(repeat(emit1(1)), [], max_out=0)
+    assert r.outputs == []
+    assert r.terminated_by == "limit"

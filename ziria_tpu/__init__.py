@@ -1,0 +1,54 @@
+"""ziria-tpu: a TPU-native stream-computation framework.
+
+A from-scratch re-design of the capabilities of the reference system
+(moxfun/Ziria, a DSL + optimizing compiler for wireless PHY stream
+processing — see SURVEY.md): a Python-embedded component/combinator DSL
+(`take`/`emit`/`map`, `>>>` pipelines, `|>>>|` parallel pipelines), a
+cardinality (synchronous-dataflow rate) analysis, and two execution
+backends:
+
+- an *interpreter* backend — the semantic oracle, streaming item-at-a-time;
+- a *jit* backend — static-rate pipeline segments fuse into a single
+  `jax.jit` step function (reshape/vmap/scan compositions), with chunk
+  widths chosen by the vectorization planner becoming array axes, frames
+  batched over a `jax.sharding.Mesh` data axis, and parallel-pipeline
+  stages sharded over chips.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+    core/      IR node types, cardinality analysis, pipeline planning
+    interp/    streaming interpreter (oracle)
+    backend/   JAX lowering: fused jit step functions, vectorization planner
+    ops/       DSP primitive library (FFT, FIR, Viterbi incl. Pallas kernel,
+               bit/CRC/scrambler/coding utilities)
+    phy/       802.11a/g PHY: TX chain, RX chain, channel models, loopback
+    parallel/  mesh construction, frame-batch sharding, stage sharding
+    runtime/   host driver loop, typed stream file I/O, params/CLI
+    utils/     dtype policy, tolerance differ (BlinkDiff equivalent), bits
+"""
+
+__version__ = "0.1.0"
+
+from ziria_tpu.core.ir import (  # noqa: F401
+    Comp,
+    take,
+    takes,
+    emit,
+    emit1,
+    emits,
+    ret,
+    seq,
+    let,
+    let_ref,
+    assign,
+    zmap,
+    map_accum,
+    repeat,
+    pipe,
+    par_pipe,
+    for_loop,
+    while_loop,
+    branch,
+    jax_block,
+)
+from ziria_tpu.core.card import Card, cardinality  # noqa: F401

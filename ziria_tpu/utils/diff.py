@@ -1,0 +1,85 @@
+"""Tolerance comparator — the BlinkDiff equivalent.
+
+The reference's golden-file tests compare program output against ground
+truth with `tools/BlinkDiff`, which tolerates bounded numeric deviation
+(SURVEY.md §4) because vectorization/LUT rewrites may legally perturb low
+bits. Same policy here: exact equality for integer/bit streams, bounded
+absolute+relative error for floats/complex, with a precise first-mismatch
+report for debugging.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class DiffReport:
+    ok: bool
+    message: str
+    n_mismatch: int = 0
+    first_index: Optional[int] = None
+    max_abs_err: float = 0.0
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+
+def stream_diff(got, want, atol: float = 0.0, rtol: float = 0.0,
+                name: str = "stream") -> DiffReport:
+    """Compare two streams (arrays). Integer dtypes require exactness
+    regardless of atol/rtol; floats/complex use atol + rtol*|want|."""
+    got = np.asarray(got)
+    want = np.asarray(want)
+    if got.shape != want.shape:
+        return DiffReport(False,
+                          f"{name}: shape mismatch got {got.shape} "
+                          f"want {want.shape}")
+    if got.size == 0:
+        return DiffReport(True, f"{name}: empty, equal")
+
+    def _exact_dtype(dt):
+        return np.issubdtype(dt, np.integer) or dt == np.bool_
+
+    exact = _exact_dtype(got.dtype) and _exact_dtype(want.dtype)
+    if exact:
+        neq = got != want
+        if neq.any():
+            flat = np.flatnonzero(neq.reshape(-1))
+            i = int(flat[0])
+            return DiffReport(
+                False,
+                f"{name}: {flat.size}/{got.size} integer mismatches; first "
+                f"at flat index {i}: got {got.reshape(-1)[i]} want "
+                f"{want.reshape(-1)[i]}",
+                n_mismatch=int(flat.size), first_index=i)
+        return DiffReport(True, f"{name}: {got.size} items exactly equal")
+
+    err = np.abs(got.astype(np.complex128) - want.astype(np.complex128))
+    tol = atol + rtol * np.abs(want.astype(np.complex128))
+    bad = err > tol
+    if bad.any():
+        flat = np.flatnonzero(bad.reshape(-1))
+        i = int(flat[0])
+        return DiffReport(
+            False,
+            f"{name}: {flat.size}/{got.size} items exceed tol "
+            f"(atol={atol}, rtol={rtol}); first at flat index {i}: got "
+            f"{got.reshape(-1)[i]} want {want.reshape(-1)[i]} "
+            f"(err {err.reshape(-1)[i]:.3g}); max err {err.max():.3g}",
+            n_mismatch=int(flat.size), first_index=i,
+            max_abs_err=float(err.max()))
+    return DiffReport(True,
+                      f"{name}: {got.size} items within tol "
+                      f"(max err {float(err.max()):.3g})",
+                      max_abs_err=float(err.max()))
+
+
+def assert_stream_eq(got, want, atol: float = 0.0, rtol: float = 0.0,
+                     name: str = "stream") -> None:
+    rep = stream_diff(got, want, atol=atol, rtol=rtol, name=name)
+    if not rep:
+        raise AssertionError(rep.message)
