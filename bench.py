@@ -1,0 +1,87 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Current flagship config (will upgrade as the PHY lands, BASELINE.md):
+config #1, the FIR low-pass stream pipeline, fused by the jit backend and
+run on the default JAX device. Baseline is a self-measured numpy
+(C-speed, vectorized) implementation of the same semantics on the host
+CPU, per BASELINE.md's "self-measured baseline" policy — the reference
+mount was empty, so there are no published numbers to compare against.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _block(out):
+    if hasattr(out, "block_until_ready"):
+        out.block_until_ready()
+    elif isinstance(out, tuple) and hasattr(out[0], "block_until_ready"):
+        out[0].block_until_ready()
+
+
+def _time(fn, *args, reps=5):
+    _block(fn(*args))  # warm-up / compile, fully drained before timing
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _block(out)  # jax async dispatch: drain before stopping the clock
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import ziria_tpu as z
+    from ziria_tpu.backend.lower import lower
+
+    n = 1 << 20  # 1M samples
+    taps = np.array([0.0625, 0.25, 0.375, 0.25, 0.0625], dtype=np.float32)
+    k = taps.size
+    xs = np.random.default_rng(0).standard_normal(n).astype(np.float32)
+
+    # --- numpy baseline: same FIR semantics (causal, zero-initial state)
+    def np_fir(x):
+        return np.convolve(x, taps)[: x.size].astype(np.float32)
+
+    t_np = _time(np_fir, xs)
+
+    # --- ziria_tpu: chunked FIR block (overlap-save) as an arity-N map_accum
+    CH = 4096
+
+    def fir_chunk(state, chunk):
+        ext = jnp.concatenate([state, chunk])
+        y = jnp.convolve(ext, jnp.asarray(taps), mode="valid",
+                         precision="highest")
+        return ext[-(k - 1):], y
+
+    prog = z.map_accum(fir_chunk, np.zeros(k - 1, np.float32),
+                       in_arity=CH, out_arity=CH, name="fir_os")
+    lw = lower(prog, width=1)
+    scan = jax.jit(lw.scan_steps())
+    chunks = jnp.asarray(xs.reshape(-1, CH))
+
+    def run(c):
+        carry, ys = scan(lw.init_carry, c)
+        return ys
+
+    t_jax = _time(run, chunks)
+
+    # correctness gate: bench numbers only count if outputs agree
+    got = np.asarray(run(chunks)).reshape(-1)
+    ref = np_fir(xs)
+    assert np.allclose(got, ref, atol=1e-4), "bench output mismatch"
+
+    sps = n / t_jax
+    print(json.dumps({
+        "metric": "fir_lowpass_samples_per_sec",
+        "value": round(sps, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(t_np / t_jax, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

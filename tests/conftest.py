@@ -2,13 +2,19 @@
 
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding paths
 (mesh/pjit/shard_map) are exercised without TPU hardware; the driver's
-separate dryrun validates the same thing. Must run before jax imports.
+separate dryrun validates the same thing. The environment exports
+JAX_PLATFORMS=axon and the axon plugin wins over an env-var override, so
+force the platform via jax.config before any backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,242 @@
+"""JAX lowering: fuse a static-rate pipeline into one jit step function.
+
+Where the reference compiles each component to C init/tick/process state
+machines glued by buffer calls (SURVEY.md §2.1 CgMonad/CgExpr and §3.2's
+tick/process hot loop), this backend turns the *whole* static-cardinality
+pipeline segment into a single pure function
+
+    step : (carry, in_chunk) -> (carry, out_chunk)
+
+and lets XLA fuse it. The synchronous-dataflow steady state (core/card.py)
+gives each stage a firing count per iteration; a planner width ``W``
+multiplies that by how many steady-state iterations one step processes.
+Per stage:
+
+- stateless stages (``Map``, ``Repeat`` of a static computer) become
+  ``reshape (F, arity, ...) -> vmap -> reshape`` — F = reps*W parallel
+  firings on the VPU/MXU, the analogue of the reference vectorizer's
+  widened take/emit arrays;
+- stateful stages (``MapAccum``, ``JaxBlock``) become ``lax.scan`` over
+  their F firings (sequential by data dependence, exactly like the
+  reference's stateful blocks);
+- ``Repeat`` bodies are turned into firing functions by *tracing the
+  interpreter* with jax values — the oracle and the compiler share one
+  semantics, so they cannot drift.
+
+Vectorization is therefore *planning, not rewriting*: no AST transform,
+no mitigator insertion — rate mismatches are handled by the reshape
+algebra, and W is a tuning knob (see ``plan_width``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ziria_tpu.core import ir
+from ziria_tpu.core.card import CCard, SteadyState, cardinality, steady_state
+from ziria_tpu.core.ir import Env
+from ziria_tpu.interp.interp import _run
+
+
+class LowerError(Exception):
+    """A pipeline (segment) can't be lowered to the jit backend. The
+    message says which node and why; such programs still run on the
+    interpreter backend."""
+
+
+# --------------------------------------------------------------------------
+# Computer body -> firing function, by tracing the interpreter
+# --------------------------------------------------------------------------
+
+
+def firing_fn(body: ir.Comp) -> Tuple[Callable, int, int]:
+    """Build ``fire(in_items) -> out_items`` for a static computer body.
+
+    in_items has shape (take, *item); out_items (emit, *item_out) — for
+    take/emit == 1 the bare item is used. The body is executed by the
+    streaming interpreter with xp=jnp, so jax tracers flow through it;
+    data-dependent control flow (While / value Branch) raises a
+    TracerBoolConversionError, which we re-raise as LowerError with
+    guidance.
+    """
+    c = cardinality(body)
+    if not isinstance(c, CCard):
+        raise LowerError(
+            f"cannot lower computer body {body.label()}: cardinality is "
+            f"not static")
+    n_take, n_emit = c.take, c.emit
+    if n_emit == 0:
+        raise LowerError(
+            f"cannot lower pure-sink body {body.label()} (emits nothing): "
+            f"jit segments produce output chunks; run sink computations on "
+            f"the interpreter backend")
+
+    def fire(in_items):
+        idx = [0]
+
+        def src():
+            if idx[0] >= n_take:
+                raise LowerError(
+                    f"body {body.label()} took more than its static "
+                    f"cardinality {n_take}")
+            x = in_items if n_take == 1 else in_items[idx[0]]
+            idx[0] += 1
+            return x
+
+        outs = []
+        gen = _run(body, Env(), src, xp=jnp)
+        try:
+            while True:
+                outs.append(next(gen))
+        except StopIteration:
+            pass
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            raise LowerError(
+                f"body {body.label()} has data-dependent control flow; "
+                f"express it with lax.cond/select inside a map/jax_block "
+                f"instead, or run on the interpreter backend") from e
+        if len(outs) != n_emit:
+            raise LowerError(
+                f"body {body.label()} emitted {len(outs)} items, static "
+                f"cardinality says {n_emit}")
+        if n_emit == 1:
+            return jnp.asarray(outs[0])
+        return jnp.stack([jnp.asarray(o) for o in outs])
+
+    return fire, n_take, n_emit
+
+
+# --------------------------------------------------------------------------
+# Per-stage lowering
+# --------------------------------------------------------------------------
+
+
+def _apply_parallel(f: Callable, chunk, a: int, b: int, F: int):
+    """Apply stateless per-firing f over F firings packed in `chunk`
+    ((F*a, *item) -> (F*b, *item_out)) via reshape + vmap."""
+    xs = chunk if a == 1 else chunk.reshape((F, a) + chunk.shape[1:])
+    ys = jax.vmap(f)(xs)
+    return ys if b == 1 else ys.reshape((F * b,) + ys.shape[2:])
+
+
+def _apply_scan(f: Callable, state, chunk, a: int, b: int, F: int):
+    """Apply stateful per-firing f over F firings sequentially (lax.scan)."""
+    xs = chunk if a == 1 else chunk.reshape((F, a) + chunk.shape[1:])
+    state, ys = lax.scan(f, state, xs)
+    return state, (ys if b == 1 else ys.reshape((F * b,) + ys.shape[2:]))
+
+
+@dataclass
+class _Stage:
+    fn: Callable  # (state, chunk) -> (state, out_chunk)
+    init_state: Any
+    label: str
+
+
+def _lower_stage(stage: ir.Comp, F: int) -> _Stage:
+    if isinstance(stage, ir.Map):
+        a, b = stage.in_arity, stage.out_arity
+
+        def fn(state, chunk, _f=stage.f, _a=a, _b=b, _F=F):
+            return state, _apply_parallel(_f, chunk, _a, _b, _F)
+
+        return _Stage(fn, None, stage.label())
+
+    if isinstance(stage, (ir.MapAccum, ir.JaxBlock)):
+        a, b = stage.in_arity, stage.out_arity
+
+        def fn(state, chunk, _f=stage.f, _a=a, _b=b, _F=F):
+            return _apply_scan(_f, state, chunk, _a, _b, _F)
+
+        init = jax.tree.map(jnp.asarray, stage.init_state())
+        return _Stage(fn, init, stage.label())
+
+    if isinstance(stage, ir.Repeat):
+        fire, a, b = firing_fn(stage.body)
+        if a == 0:
+            raise LowerError(
+                "cannot lower a pure-source repeat inside a fused segment")
+
+        def fn(state, chunk, _f=fire, _a=a, _b=b, _F=F):
+            return state, _apply_parallel(_f, chunk, _a, _b, _F)
+
+        return _Stage(fn, None, f"repeat({stage.body.label()})")
+
+    raise LowerError(
+        f"stage {stage.label()} ({type(stage).__name__}) is not lowerable: "
+        f"jit segments are built from Map/MapAccum/JaxBlock/Repeat-of-"
+        f"static-computer; run dynamic structure on the interpreter or "
+        f"wrap it in a jax_block")
+
+
+# --------------------------------------------------------------------------
+# Whole-pipeline lowering
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Lowered:
+    """A fused pipeline segment: call ``step(carry, in_chunk)``; in_chunk
+    carries ``take`` items (leading axis), out ``emit`` items."""
+
+    step: Callable
+    init_carry: Tuple
+    take: int
+    emit: int
+    width: int
+    ss: SteadyState
+    labels: Tuple[str, ...]
+
+    def scan_steps(self):
+        """(carry, chunks[T, take, ...]) -> (carry, outs[T, emit, ...]) —
+        the whole bulk of a stream in one XLA while-loop."""
+
+        def many(carry, chunks):
+            return lax.scan(self.step, carry, chunks)
+
+        return many
+
+
+def plan_width(ss: SteadyState, target_items: int = 8192) -> int:
+    """Pick how many steady-state iterations one step processes.
+
+    The reference's vectorizer searches per-segment (in,out) scale factors
+    with a utility model (SURVEY.md §2.1 VecSF); on TPU the considerations
+    collapse to "make the fused chunk big enough to fill the VPU/MXU and
+    amortize dispatch": default to ~target_items items per chunk.
+    """
+    per_iter = max(ss.take, ss.emit, 1)
+    return max(1, target_items // per_iter)
+
+
+def lower(comp: ir.Comp, width: Optional[int] = None,
+          target_items: int = 8192) -> Lowered:
+    """Lower a static-rate pipeline to a fused step function."""
+    stages = ir.pipeline_stages(comp)
+    ss = steady_state(stages)
+    if ss is None:
+        raise LowerError(
+            "pipeline has no static steady state; stages: "
+            + ", ".join(s.label() for s in stages))
+    W = width if width is not None else plan_width(ss, target_items)
+    lowered = [_lower_stage(s, r * W) for s, r in zip(stages, ss.reps)]
+    init_carry = tuple(s.init_state for s in lowered)
+
+    def step(carry, chunk):
+        new_carry = []
+        for st, c in zip(lowered, carry):
+            c, chunk2 = st.fn(c, chunk)
+            new_carry.append(c)
+            chunk = chunk2
+        return tuple(new_carry), chunk
+
+    return Lowered(step=step, init_carry=init_carry, take=ss.take * W,
+                   emit=ss.emit * W, width=W, ss=ss,
+                   labels=tuple(s.label for s in lowered))

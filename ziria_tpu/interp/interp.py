@@ -39,7 +39,7 @@ class UpstreamDone(Exception):
         self.token = token
 
 
-def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
+def _run(comp: ir.Comp, env: Env, source: Callable[[], Any], xp=np):
     """Generator: yields emitted items; returns the control value."""
     if isinstance(comp, ir.Take):
         return source()
@@ -47,7 +47,7 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
 
     if isinstance(comp, ir.Takes):
         items = [source() for _ in range(comp.n)]
-        return np.stack([np.asarray(x) for x in items])
+        return xp.stack([xp.asarray(x) for x in items])
         yield  # pragma: no cover
 
     if isinstance(comp, ir.Emit):
@@ -55,7 +55,7 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
         return None
 
     if isinstance(comp, ir.Emits):
-        arr = np.asarray(eval_expr(comp.expr, env))
+        arr = xp.asarray(eval_expr(comp.expr, env))
         if arr.ndim == 0 or arr.shape[0] != comp.n:
             raise ValueError(
                 f"emits: declared n={comp.n} but expression has shape "
@@ -69,16 +69,16 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
         yield  # pragma: no cover
 
     if isinstance(comp, ir.Bind):
-        v = yield from _run(comp.first, env, source)
+        v = yield from _run(comp.first, env, source, xp)
         if comp.var is not None:
             env = env.child()
             env.bind(comp.var, v)
-        return (yield from _run(comp.rest, env, source))
+        return (yield from _run(comp.rest, env, source, xp))
 
     if isinstance(comp, ir.LetRef):
         env = env.child()
         env.bind_ref(comp.var, eval_expr(comp.init, env))
-        return (yield from _run(comp.body, env, source))
+        return (yield from _run(comp.body, env, source, xp))
 
     if isinstance(comp, ir.Assign):
         env.set(comp.var, eval_expr(comp.expr, env))
@@ -92,7 +92,7 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
             if comp.in_arity == 1:
                 x = source()
             else:
-                x = np.stack([np.asarray(source())
+                x = xp.stack([xp.asarray(source())
                               for _ in range(comp.in_arity)])
             if stateful:
                 state, y = comp.f(state, x)
@@ -101,7 +101,7 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
             if comp.out_arity == 1:
                 yield y
             else:
-                y = np.asarray(y)
+                y = xp.asarray(y)
                 for k in range(comp.out_arity):
                     yield y[k]
 
@@ -124,7 +124,7 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
         while True:
             before = takes_seen[0]
             emitted = False
-            it = _run(comp.body, env, counting_source)
+            it = _run(comp.body, env, counting_source, xp)
             try:
                 while True:
                     item = next(it)
@@ -145,23 +145,23 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
             if comp.var is not None:
                 e = env.child()
                 e.bind(comp.var, i)
-            v = yield from _run(comp.body, e, source)
+            v = yield from _run(comp.body, e, source, xp)
         return v
 
     if isinstance(comp, ir.While):
         v = None
         while bool(eval_expr(comp.cond, env)):
-            v = yield from _run(comp.body, env, source)
+            v = yield from _run(comp.body, env, source, xp)
         return v
 
     if isinstance(comp, ir.Branch):
         tgt = comp.then if bool(eval_expr(comp.cond, env)) else comp.els
-        return (yield from _run(tgt, env, source))
+        return (yield from _run(tgt, env, source, xp))
 
     if isinstance(comp, (ir.Pipe, ir.ParPipe)):
         # ParPipe is semantically identical to Pipe here (the reference's
         # |>>>| must produce output identical to >>>; SURVEY.md §4).
-        up_gen = _run(comp.up, env, source)
+        up_gen = _run(comp.up, env, source, xp)
         token = object()  # identifies THIS pipe's upstream termination
 
         def down_source():
@@ -177,7 +177,7 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any]):
         # value). Untagged/foreign UpstreamDone = outer input EOF or an
         # outer pipe's upstream — propagate.
         try:
-            return (yield from _run(comp.down, env, down_source))
+            return (yield from _run(comp.down, env, down_source, xp))
         except UpstreamDone as e:
             if e.token is token:
                 return e.value
