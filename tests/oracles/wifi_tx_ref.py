@@ -1,0 +1,87 @@
+"""Independent numpy oracle for the 802.11a TX chain.
+
+Written loop-style from the standard's block definitions, reusing the
+per-op oracles (np_*_ref) — deliberately NOT sharing code with the jax
+implementation it checks (golden-file pattern, SURVEY.md §4).
+"""
+
+import numpy as np
+
+from ziria_tpu.ops.coding import np_conv_encode_ref, PUNCTURE_KEEP
+from ziria_tpu.ops.interleave import np_interleave_ref
+from ziria_tpu.ops.modulate import np_modulate_ref
+from ziria_tpu.ops.scramble import np_scramble_ref
+from ziria_tpu.phy.wifi.params import RATES, N_SERVICE_BITS, N_TAIL_BITS
+
+PILOT_SC = [-21, -7, 7, 21]
+DATA_SC = [k for k in range(-26, 27)
+           if k != 0 and k not in PILOT_SC]
+
+
+def pilot_polarity_ref():
+    s = [1] * 7
+    out = []
+    for _ in range(127):
+        fb = s[6] ^ s[3]
+        out.append(1.0 if fb == 0 else -1.0)
+        s = [fb] + s[:6]
+    return out
+
+
+def symbol_to_time_ref(data_syms, pilot_idx):
+    """48 data symbols + pilot polarity index -> 80 time samples."""
+    pol = pilot_polarity_ref()[pilot_idx % 127]
+    bins = np.zeros(64, np.complex128)
+    for sc, v in zip(DATA_SC, data_syms):
+        bins[sc % 64] = v
+    for sc, pv in zip(PILOT_SC, [1, 1, 1, -1]):
+        bins[sc % 64] = pv * pol
+    t = np.fft.ifft(bins) * 64 / np.sqrt(52.0)
+    return np.concatenate([t[-16:], t])
+
+
+def puncture_ref(coded, rate):
+    keep = PUNCTURE_KEEP[rate]
+    out = [b for i, b in enumerate(coded) if keep[i % keep.size]]
+    return np.array(out, np.uint8)
+
+
+def tx_frame_ref(psdu_bits, rate_mbps, seed_val=0b1011101):
+    """Full frame: preamble + SIGNAL + DATA, complex128 samples."""
+    rate = RATES[rate_mbps]
+    length_bytes = len(psdu_bits) // 8
+    n_bits = N_SERVICE_BITS + len(psdu_bits) + N_TAIL_BITS
+    n_sym = -(-n_bits // rate.n_dbps)
+    pad = n_sym * rate.n_dbps - n_bits
+
+    raw = np.concatenate([np.zeros(N_SERVICE_BITS, np.uint8),
+                          np.asarray(psdu_bits, np.uint8),
+                          np.zeros(N_TAIL_BITS + pad, np.uint8)])
+    seed = np.array([(seed_val >> k) & 1 for k in range(7)], np.uint8)
+    scrambled = np_scramble_ref(raw, seed)
+    tail_at = N_SERVICE_BITS + len(psdu_bits)
+    scrambled[tail_at: tail_at + N_TAIL_BITS] = 0
+
+    coded = puncture_ref(np_conv_encode_ref(scrambled), rate.coding)
+    inter = np_interleave_ref(coded, rate.n_cbps, rate.n_bpsc)
+    syms = np_modulate_ref(inter, rate.n_bpsc).reshape(n_sym, 48)
+    data_t = np.concatenate(
+        [symbol_to_time_ref(syms[s], 1 + s) for s in range(n_sym)])
+
+    # SIGNAL
+    rate_bits = [(rate.signal_bits >> k) & 1 for k in (3, 2, 1, 0)]
+    length_bits = [(length_bytes >> k) & 1 for k in range(12)]
+    head = rate_bits + [0] + length_bits
+    sig = np.array(head + [sum(head) % 2] + [0] * 6, np.uint8)
+    sig_coded = np_conv_encode_ref(sig)
+    sig_inter = np_interleave_ref(sig_coded, 48, 1)
+    sig_syms = np_modulate_ref(sig_inter, 1)
+    sig_t = symbol_to_time_ref(sig_syms, 0)
+
+    # preamble (same constants as the implementation; structure checked
+    # separately in test_ops)
+    from ziria_tpu.ops.ofdm import preamble
+    p = np.asarray(preamble())  # pair format (320, 2)
+    pre = p[..., 0] + 1j * p[..., 1]
+
+    return np.concatenate([pre, sig_t, data_t])

@@ -1,0 +1,83 @@
+"""802.11a TX chain vs independent numpy oracle, plus the DSL pipeline
+form vs the frame-level form."""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.ops import coding, cplx, interleave, modulate, ofdm, scramble
+from ziria_tpu.phy.wifi import tx
+from ziria_tpu.phy.wifi.params import RATES, n_symbols
+from ziria_tpu.utils.bits import uint_to_bits
+from ziria_tpu.utils.diff import assert_stream_eq
+from tests.oracles.wifi_tx_ref import tx_frame_ref
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.mark.parametrize("rate", [6, 9, 12, 18, 24, 36, 48, 54])
+def test_tx_frame_vs_oracle(rate):
+    psdu = RNG.integers(0, 2, 8 * 25).astype(np.uint8)  # 25-byte PSDU
+    got = cplx.to_complex(np.asarray(tx.encode_frame_bits(psdu, RATES[rate])))
+    want = tx_frame_ref(psdu, rate)
+    assert got.shape == want.shape
+    assert_stream_eq(got, want, atol=2e-4, name=f"tx@{rate}")
+
+
+def test_tx_frame_length():
+    rate = RATES[24]
+    psdu = np.zeros(8 * 100, np.uint8)
+    out = np.asarray(tx.encode_frame_bits(psdu, rate))
+    n_sym = n_symbols(100, rate)
+    assert out.shape == (320 + 80 + 80 * n_sym, 2)
+
+
+def test_signal_field_parity_and_layout():
+    bits = np.asarray(tx.signal_field_bits(RATES[36], 100))
+    assert bits.shape == (24,)
+    # tail bits zero, parity makes first 18 bits even
+    assert bits[18:].sum() == 0
+    assert bits[:18].sum() % 2 == 0
+    # RATE bits R1..R4 = 1011 for 36 Mbps
+    assert list(bits[:4]) == [1, 0, 1, 1]
+    # LENGTH=100 LSB-first in bits 5..16
+    assert int(sum(int(b) << k for k, b in enumerate(bits[5:17]))) == 100
+
+
+def test_batched_frames_vmap():
+    import jax
+    rate = RATES[12]
+    psdus = RNG.integers(0, 2, (4, 8 * 30)).astype(np.uint8)
+    batched = jax.jit(jax.vmap(lambda p: tx.encode_frame_bits(p, rate)))
+    got = np.asarray(batched(psdus))
+    for i in range(4):
+        want = np.asarray(tx.encode_frame_bits(psdus[i], rate))
+        assert_stream_eq(got[i], want, atol=1e-5, name=f"frame{i}")
+
+
+@pytest.mark.parametrize("rate", [6, 54])
+def test_tx_symbol_pipeline_matches_ops(rate):
+    """The DSL pipeline form (map_accum stages) produces the same DATA
+    symbols as applying the ops to the whole stream at once."""
+    p = RATES[rate]
+    n_sym = 5
+    bits = RNG.integers(0, 2, n_sym * p.n_dbps).astype(np.uint8)
+
+    got = run_jit(tx.tx_symbol_pipeline(rate), bits, width=2)
+
+    seed = uint_to_bits(np.uint32(0b1011101), 7)
+    scrambled = scramble.scramble_bits(bits, seed)
+    coded = coding.puncture(coding.conv_encode(scrambled), p.coding)
+    inter = interleave.interleave(coded, p.n_cbps, p.n_bpsc)
+    syms = modulate.modulate(inter, p.n_bpsc).reshape(n_sym, 48, 2)
+    bins = ofdm.map_subcarriers(syms, symbol_index0=1)
+    want = np.asarray(ofdm.ofdm_modulate(bins)).reshape(-1, 2)
+
+    assert_stream_eq(np.asarray(got), want, atol=2e-5, name=f"pipe@{rate}")
+
+
+def test_add_fcs_changes_length():
+    psdu = np.zeros(10, np.uint8)
+    a = np.asarray(tx.encode_frame(psdu, 6))
+    b = np.asarray(tx.encode_frame(psdu, 6, add_fcs=True))
+    assert b.shape[0] > a.shape[0]

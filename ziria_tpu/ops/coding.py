@@ -1,0 +1,87 @@
+"""Convolutional encoding (K=7, g0=133o, g1=171o) and puncturing.
+
+Counterpart of the reference's `encoding.blk` (1/2-rate encoder +
+puncturing to 2/3 and 3/4 — SURVEY.md §2.3). TPU-native: the encoder is
+a binary convolution — both generator outputs computed as one
+``jnp.convolve`` (integer) mod 2 over the whole bit stream, no per-bit
+state machine; puncturing/depuncturing are reshape+mask index maps
+precomputed per rate.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# generator taps, delay order (tap[d] multiplies x_{k-d})
+G0 = np.array([1, 0, 1, 1, 0, 1, 1], np.int32)  # 133 octal
+G1 = np.array([1, 1, 1, 1, 0, 0, 1], np.int32)  # 171 octal
+K = 7
+
+# puncturing patterns over one period of coded (A,B) pairs:
+# rate 1/2: keep all; rate 2/3: [A0 B0 A1 .]; rate 3/4: [A0 B0 A1 . . B2]
+PUNCTURE_KEEP = {
+    "1/2": np.array([1, 1], bool),
+    "2/3": np.array([1, 1, 1, 0], bool),
+    "3/4": np.array([1, 1, 1, 0, 0, 1], bool),
+}
+
+
+def conv_encode(bits) -> jnp.ndarray:
+    """Rate-1/2 encode: (n,) bits -> (2n,) coded bits interleaved
+    A0 B0 A1 B1 ... (encoder starts in the all-zero state)."""
+    x = jnp.asarray(bits, jnp.int32)
+    a = jnp.convolve(x, jnp.asarray(G0))[: x.shape[0]] % 2
+    b = jnp.convolve(x, jnp.asarray(G1))[: x.shape[0]] % 2
+    return jnp.stack([a, b], axis=1).reshape(-1).astype(jnp.uint8)
+
+
+def puncture(coded, rate: str) -> jnp.ndarray:
+    """Drop coded bits per the standard pattern for '2/3' or '3/4'
+    ('1/2' is the identity). Input length must be a multiple of the
+    pattern period."""
+    keep = PUNCTURE_KEEP[rate]
+    if rate == "1/2":
+        return jnp.asarray(coded, jnp.uint8)
+    coded = jnp.asarray(coded, jnp.uint8)
+    p = keep.size
+    if coded.shape[0] % p:
+        raise ValueError(
+            f"punctured block length {coded.shape[0]} not a multiple of "
+            f"pattern period {p}")
+    blocks = coded.reshape(-1, p)
+    return blocks[:, np.flatnonzero(keep)].reshape(-1)
+
+
+def depuncture(bits, rate: str, fill=0.0) -> jnp.ndarray:
+    """Inverse of puncture for soft values: re-insert `fill` (erasure,
+    0 LLR) at dropped positions. Works on float LLR arrays."""
+    keep = PUNCTURE_KEEP[rate]
+    vals = jnp.asarray(bits)
+    if rate == "1/2":
+        return vals
+    p = keep.size
+    kept = int(keep.sum())
+    if vals.shape[0] % kept:
+        raise ValueError(
+            f"depuncture input length {vals.shape[0]} not a multiple of "
+            f"kept-count {kept}")
+    nblk = vals.shape[0] // kept
+    out = jnp.full((nblk, p), fill, vals.dtype)
+    out = out.at[:, np.flatnonzero(keep)].set(vals.reshape(nblk, kept))
+    return out.reshape(-1)
+
+
+def np_conv_encode_ref(bits: np.ndarray) -> np.ndarray:
+    """Independent oracle: explicit shift-register loop. Tests only."""
+    sr = [0] * (K - 1)
+    out = []
+    for b in np.asarray(bits, np.uint8):
+        window = [int(b)] + sr
+        a = sum(g * w for g, w in zip(G0, window)) % 2
+        bb = sum(g * w for g, w in zip(G1, window)) % 2
+        out += [a, bb]
+        sr = window[:-1]
+    return np.array(out, np.uint8)

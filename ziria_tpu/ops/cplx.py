@@ -1,0 +1,140 @@
+"""Real-pair complex arithmetic and matmul DFTs — the framework's
+canonical sample representation.
+
+Two reasons this exists:
+
+1. It mirrors the reference: SORA carries `complex16`/`complex32` as
+   integer re/im pairs, never a hardware complex type (SURVEY.md §2.2
+   `numerics.c`). The TPU analogue is a trailing axis of size 2 over
+   f32/bf16 (or int16 for the fixed-point path).
+2. The axon TPU backend has **no complex64 support at all** — any
+   complex op fails `UNIMPLEMENTED` — so jnp.complex64 may appear only
+   in CPU-side test oracles, never on the device path.
+
+FFTs on this representation are DFT matrix multiplies: at n=64 (the
+802.11 symbol size) a pair of 64x64 f32 matmuls per re/im component is
+exactly the MXU's shape, and batching over symbols/frames makes it one
+big GEMM — faster than a generic small-FFT on TPU and the reason the
+reference's SSE FFT brick maps so well here.
+
+Convention: ``p[..., 0]`` = real, ``p[..., 1]`` = imag.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def cpack(re, im):
+    return jnp.stack([re, im], axis=-1)
+
+
+def cre(p):
+    return p[..., 0]
+
+
+def cim(p):
+    return p[..., 1]
+
+
+def conj(p):
+    return jnp.stack([p[..., 0], -p[..., 1]], axis=-1)
+
+
+def cmul(a, b):
+    """Elementwise complex multiply of pair arrays."""
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    return jnp.stack([ar * br - ai * bi, ar * bi + ai * br], axis=-1)
+
+
+def cmul_conj(a, b):
+    """a * conj(b)."""
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    return jnp.stack([ar * br + ai * bi, ai * br - ar * bi], axis=-1)
+
+
+def cscale(p, s):
+    return p * jnp.asarray(s)[..., None]
+
+def cabs2(p):
+    return p[..., 0] ** 2 + p[..., 1] ** 2
+
+
+def cdiv(a, b, eps: float = 1e-12):
+    """a / b (pairwise); eps regularizes |b|^2 so a zero divisor (e.g. a
+    dead subcarrier in an estimated channel) yields 0, not NaN."""
+    num = cmul_conj(a, b)
+    den = cabs2(b) + eps
+    return num / den[..., None]
+
+
+def cexp(theta):
+    """unit phasor pair from angle(s)."""
+    return jnp.stack([jnp.cos(theta), jnp.sin(theta)], axis=-1)
+
+
+def cangle(p):
+    return jnp.arctan2(p[..., 1], p[..., 0])
+
+
+# ----------------------------------------------------------------- dft
+
+@lru_cache(maxsize=None)
+def _dft_mats(n: int, inverse: bool):
+    k = np.arange(n)
+    ang = 2.0 * np.pi * np.outer(k, k) / n
+    sign = 1.0 if inverse else -1.0
+    c = np.cos(ang).astype(np.float32)
+    s = (sign * np.sin(ang)).astype(np.float32)
+    if inverse:
+        c /= n
+        s /= n
+    return c, s
+
+
+def dft_pair(p, inverse: bool = False, axis: int = -2):
+    """DFT along `axis` of a pair array (axis counts among the non-pair
+    dims; default: the axis right before the re/im axis). numpy-fft
+    convention: forward unscaled, inverse scaled by 1/n."""
+    p = jnp.asarray(p)
+    if axis != -2:
+        p = jnp.moveaxis(p, axis, -2)
+    n = p.shape[-2]
+    c, s = _dft_mats(n, inverse)
+    c = jnp.asarray(c)
+    s = jnp.asarray(s)
+    xr, xi = p[..., 0], p[..., 1]
+    # W = C + iS; y = W x
+    yr = xr @ c.T - xi @ s.T
+    yi = xr @ s.T + xi @ c.T
+    out = jnp.stack([yr, yi], axis=-1)
+    if axis != -2:
+        out = jnp.moveaxis(out, -2, axis)
+    return out
+
+
+def fft_pair(p, axis: int = -2):
+    return dft_pair(p, inverse=False, axis=axis)
+
+
+def ifft_pair(p, axis: int = -2):
+    return dft_pair(p, inverse=True, axis=axis)
+
+
+# ------------------------------------------------- host-side conversion
+
+def from_complex(c, xp=np):
+    """complex array -> pair array (host/test use)."""
+    c = xp.asarray(c)
+    return xp.stack([c.real, c.imag], axis=-1).astype(xp.float32)
+
+
+def to_complex(p, xp=np):
+    """pair array -> complex array (host/test use)."""
+    p = xp.asarray(p)
+    return (p[..., 0] + 1j * p[..., 1]).astype(xp.complex64)

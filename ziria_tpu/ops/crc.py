@@ -1,0 +1,80 @@
+"""CRC-32 (the 802.11 FCS) over bit streams.
+
+Counterpart of the reference's `crc.blk` in the TX chain (SURVEY.md
+§2.3). Parameters are the standard FCS ones: polynomial 0x04C11DB7,
+init all-ones, LSB-first bit order, final complement.
+
+TPU-native design: instead of a per-bit LFSR loop, bits are grouped into
+bytes and a 256-entry lookup table drives a ``lax.scan`` over bytes —
+the table plays exactly the role of the reference's AutoLUT-generated
+tables (SURVEY.md §2.1 AutoLUT), precomputed here at module load.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ziria_tpu.utils.bits import bits_to_bytes, uint_to_bits
+
+_POLY = 0xEDB88320  # 0x04C11DB7 bit-reflected (LSB-first algorithm)
+
+
+def _make_table() -> np.ndarray:
+    tab = np.zeros(256, np.uint32)
+    for b in range(256):
+        c = b
+        for _ in range(8):
+            c = (c >> 1) ^ (_POLY if (c & 1) else 0)
+        tab[b] = c
+    return tab
+
+
+_TABLE = _make_table()
+
+
+def crc32_bytes(data) -> jnp.ndarray:
+    """CRC-32 of a uint8 byte array; returns uint32 scalar."""
+    data = jnp.asarray(data, jnp.uint8)
+    tab = jnp.asarray(_TABLE)
+
+    def step(crc, byte):
+        idx = (crc ^ byte.astype(jnp.uint32)) & 0xFF
+        return (crc >> 8) ^ tab[idx], None
+
+    crc, _ = jax.lax.scan(step, jnp.uint32(0xFFFFFFFF), data)
+    return crc ^ jnp.uint32(0xFFFFFFFF)
+
+
+def crc32_bits(bits) -> jnp.ndarray:
+    """CRC-32 of a bit stream (multiple of 8 bits, LSB-first per byte);
+    returns the 32 FCS bits in transmission order (LSB-first)."""
+    crc = crc32_bytes(bits_to_bytes(bits))
+    return uint_to_bits(crc, 32)
+
+
+def append_crc32(bits) -> jnp.ndarray:
+    """Append the 32-bit FCS to a bit stream (the TX `crc` block)."""
+    bits = jnp.asarray(bits, jnp.uint8)
+    return jnp.concatenate([bits, crc32_bits(bits)])
+
+
+def check_crc32(bits) -> jnp.ndarray:
+    """True iff the trailing 32 bits are the correct FCS of the rest."""
+    bits = jnp.asarray(bits, jnp.uint8)
+    body, fcs = bits[:-32], bits[-32:]
+    return jnp.all(crc32_bits(body) == fcs)
+
+
+def np_crc32_bits_ref(bits: np.ndarray) -> np.ndarray:
+    """Independent oracle: per-bit LFSR, straight from the CRC definition.
+    Used only by tests."""
+    reg = 0xFFFFFFFF
+    for bit in np.asarray(bits, np.uint8):
+        fb = (reg ^ int(bit)) & 1
+        reg >>= 1
+        if fb:
+            reg ^= _POLY
+    reg ^= 0xFFFFFFFF
+    return np.array([(reg >> k) & 1 for k in range(32)], np.uint8)

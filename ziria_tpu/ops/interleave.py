@@ -1,0 +1,79 @@
+"""802.11a block interleaver / deinterleaver.
+
+Counterpart of the reference's `interleaving.blk` / `deinterleaving.blk`
+(SURVEY.md §2.3). The two standard permutations (adjacent coded bits to
+nonadjacent subcarriers; adjacent bits alternate between significant/
+less-significant constellation positions) are *precomputed as one gather
+index per (n_cbps, n_bpsc)* at trace time — on TPU the interleaver is a
+single vectorized gather over each OFDM symbol's bit block, batched over
+symbols.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@lru_cache(maxsize=None)
+def interleave_perm(n_cbps: int, n_bpsc: int) -> np.ndarray:
+    """perm[j] = k : output position j carries input bit k (one symbol).
+
+    Built from the standard's two index maps (k->i then i->j), inverted
+    into a single gather.
+    """
+    s = max(n_bpsc // 2, 1)
+    k = np.arange(n_cbps)
+    i = (n_cbps // 16) * (k % 16) + k // 16
+    j = s * (i // s) + (i + n_cbps - (16 * i // n_cbps)) % s
+    # bit k of the input lands at output position j[k]; gather wants the
+    # inverse: out[j] = in[k]
+    perm = np.zeros(n_cbps, np.int32)
+    perm[j] = k
+    return perm
+
+
+@lru_cache(maxsize=None)
+def deinterleave_perm(n_cbps: int, n_bpsc: int) -> np.ndarray:
+    p = interleave_perm(n_cbps, n_bpsc)
+    inv = np.zeros_like(p)
+    inv[p] = np.arange(n_cbps, dtype=np.int32)
+    return inv
+
+
+def interleave(bits, n_cbps: int, n_bpsc: int) -> jnp.ndarray:
+    """Interleave a stream of whole symbols: (..., m*n_cbps) -> same shape."""
+    return _permute(bits, interleave_perm(n_cbps, n_bpsc), n_cbps)
+
+
+def deinterleave(vals, n_cbps: int, n_bpsc: int) -> jnp.ndarray:
+    """Inverse; also used on soft values in RX (works on any dtype)."""
+    return _permute(vals, deinterleave_perm(n_cbps, n_bpsc), n_cbps)
+
+
+def _permute(vals, perm: np.ndarray, n_cbps: int) -> jnp.ndarray:
+    vals = jnp.asarray(vals)
+    n = vals.shape[-1]
+    if n % n_cbps:
+        raise ValueError(f"length {n} not a multiple of n_cbps={n_cbps}")
+    blocks = vals.reshape(vals.shape[:-1] + (n // n_cbps, n_cbps))
+    out = blocks[..., jnp.asarray(perm)]
+    return out.reshape(vals.shape)
+
+
+def np_interleave_ref(bits: np.ndarray, n_cbps: int,
+                      n_bpsc: int) -> np.ndarray:
+    """Independent oracle: direct per-bit index computation. Tests only."""
+    bits = np.asarray(bits)
+    assert bits.size % n_cbps == 0
+    s = max(n_bpsc // 2, 1)
+    out = np.empty_like(bits)
+    for blk in range(bits.size // n_cbps):
+        base = blk * n_cbps
+        for k in range(n_cbps):
+            i = (n_cbps // 16) * (k % 16) + k // 16
+            j = s * (i // s) + (i + n_cbps - (16 * i // n_cbps)) % s
+            out[base + j] = bits[base + k]
+    return out

@@ -1,0 +1,173 @@
+"""802.11a/g OFDM transmitter chain.
+
+Counterpart of the reference's `code/WiFi/transmitter/` top-level
+`tx.blk` (SURVEY.md §2.3, §3.5): crc >>> scramble >>> convEncode+puncture
+>>> interleave >>> modulate >>> map_ofdm >>> ifft >>> preamble/CP.
+
+Two forms, per the framework's TPU-first design:
+
+- ``encode_frame`` — a *frame-level* pure jax function: the whole PSDU
+  to time-domain samples in one traced graph. This is the batched path:
+  ``jax.vmap(encode_frame_bits, ...)`` processes a batch of frames as
+  one device program (frame batching = the new data-parallel axis,
+  SURVEY.md §2.4).
+- ``tx_symbol_pipeline`` — the same DATA-symbol steady state expressed
+  as a DSL pipeline (map_accum stages carrying scrambler phase, encoder
+  tail, and symbol counter), demonstrating that the combinator IR
+  expresses the chain; it lowers through backend/lower like any stream
+  program.
+
+Frame assembly (preamble, SIGNAL symbol, padding) is inherently
+per-frame and lives only in the frame-level form.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ziria_tpu.ops import coding, interleave, modulate, ofdm, scramble
+from ziria_tpu.ops.crc import append_crc32
+from ziria_tpu.phy.wifi.params import (N_SERVICE_BITS, N_TAIL_BITS,
+                                       RateParams, RATES, n_symbols)
+from ziria_tpu.utils.bits import bytes_to_bits, uint_to_bits
+
+# the standard's example frame seed; callers may override per frame
+DEFAULT_SCRAMBLER_SEED = 0b1011101
+
+
+def _seed_bits_np(seed_val: int) -> np.ndarray:
+    return np.array([(seed_val >> k) & 1 for k in range(7)], np.uint8)
+
+
+def signal_field_bits(rate: RateParams, length_bytes: int) -> jnp.ndarray:
+    """The 24-bit SIGNAL field: RATE(4) R1-first, reserved(1), LENGTH(12)
+    LSB-first, even parity(1), tail(6)."""
+    rate_bits = uint_to_bits(np.uint32(rate.signal_bits), 4,
+                             msb_first=True)
+    length_bits = uint_to_bits(jnp.asarray(length_bytes, jnp.uint32), 12)
+    head = jnp.concatenate([rate_bits, jnp.zeros(1, jnp.uint8),
+                            length_bits])
+    parity = (head.sum() % 2).astype(jnp.uint8)
+    return jnp.concatenate([head, parity[None], jnp.zeros(6, jnp.uint8)])
+
+
+def encode_signal_symbol(rate: RateParams, length_bytes: int) -> jnp.ndarray:
+    """SIGNAL OFDM symbol (BPSK, rate 1/2, not scrambled): (80, 2)
+    pair samples."""
+    bits = signal_field_bits(rate, length_bytes)
+    coded = coding.conv_encode(bits)          # 48 bits
+    inter = interleave.interleave(coded, 48, 1)
+    syms = modulate.modulate(inter, 1)        # (48, 2) BPSK
+    bins = ofdm.map_subcarriers(syms[None, :, :], symbol_index0=0)
+    return ofdm.ofdm_modulate(bins)[0]
+
+
+def data_field_bits(psdu_bits, rate: RateParams,
+                    n_sym: int) -> jnp.ndarray:
+    """SERVICE + PSDU + tail + pad, scrambled, tail re-zeroed.
+
+    `n_sym` must be static (it sets array sizes); psdu_bits length is
+    static per trace.
+    """
+    n_bits = n_sym * rate.n_dbps
+    psdu_bits = jnp.asarray(psdu_bits, jnp.uint8)
+    n_data = N_SERVICE_BITS + psdu_bits.shape[0] + N_TAIL_BITS
+    pad = n_bits - n_data
+    raw = jnp.concatenate([
+        jnp.zeros(N_SERVICE_BITS, jnp.uint8), psdu_bits,
+        jnp.zeros(N_TAIL_BITS + pad, jnp.uint8)])
+    seed = jnp.asarray(_seed_bits_np(DEFAULT_SCRAMBLER_SEED))
+    scrambled = scramble.scramble_bits(raw, seed)
+    # tail bits are zeroed AFTER scrambling so the decoder returns to the
+    # zero state
+    tail_at = N_SERVICE_BITS + psdu_bits.shape[0]
+    return scrambled.at[tail_at: tail_at + N_TAIL_BITS].set(0)
+
+
+def encode_frame_bits(psdu_bits, rate: RateParams) -> jnp.ndarray:
+    """PSDU bits -> full frame time samples as pairs
+    (320 preamble + 80 SIGNAL + 80*n_sym DATA, 2) float32."""
+    if psdu_bits.shape[0] % 8:
+        raise ValueError(
+            f"PSDU must be whole bytes; got {psdu_bits.shape[0]} bits "
+            f"(SIGNAL LENGTH is in bytes)")
+    length_bytes = psdu_bits.shape[0] // 8
+    n_sym = n_symbols(length_bytes, rate)
+    bits = data_field_bits(psdu_bits, rate, n_sym)
+    coded = coding.puncture(coding.conv_encode(bits), rate.coding)
+    inter = interleave.interleave(coded, rate.n_cbps, rate.n_bpsc)
+    syms = modulate.modulate(inter, rate.n_bpsc).reshape(n_sym, 48, 2)
+    bins = ofdm.map_subcarriers(syms, symbol_index0=1)
+    data_t = ofdm.ofdm_modulate(bins).reshape(-1, 2)
+    sig_t = encode_signal_symbol(rate, length_bytes)
+    return jnp.concatenate([ofdm.preamble(), sig_t, data_t], axis=0)
+
+
+def encode_frame(psdu_bytes, rate_mbps: int,
+                 add_fcs: bool = False) -> jnp.ndarray:
+    """Byte-level convenience wrapper. ``add_fcs`` appends the 32-bit
+    CRC (the reference TX's crc block) to the PSDU first."""
+    rate = RATES[rate_mbps]
+    bits = bytes_to_bits(jnp.asarray(psdu_bytes, jnp.uint8))
+    if add_fcs:
+        bits = append_crc32(bits)
+    return encode_frame_bits(bits, rate)
+
+
+# --------------------------------------------------------------------------
+# DSL pipeline form (DATA-symbol steady state)
+# --------------------------------------------------------------------------
+
+
+def tx_symbol_pipeline(rate_mbps: int):
+    """DSL pipeline: n_dbps raw data bits in -> 80 time samples out per
+    firing, carrying scrambler phase / encoder tail / pilot index as
+    map_accum state. Compose with backend.lower like any stream program.
+    """
+    import ziria_tpu as z
+
+    rate = RATES[rate_mbps]
+    n_dbps, n_cbps, n_bpsc = rate.n_dbps, rate.n_cbps, rate.n_bpsc
+
+    seq_np = scramble.np_lfsr_sequence_127(
+        _seed_bits_np(DEFAULT_SCRAMBLER_SEED))
+
+    def stage_scramble(state, bits):
+        phase = state  # scalar int32: position in the 127-periodic sequence
+        seq = jnp.asarray(seq_np)
+        idx = (phase + jnp.arange(n_dbps)) % 127
+        out = jnp.asarray(bits, jnp.uint8) ^ seq[idx]
+        return (phase + n_dbps) % 127, out
+
+    def stage_encode(state, bits):
+        tail = state  # last 6 input bits of the previous symbol
+        ext = jnp.concatenate([tail, jnp.asarray(bits, jnp.int32)])
+        a = jnp.convolve(ext, jnp.asarray(coding.G0))[6: 6 + n_dbps] % 2
+        b = jnp.convolve(ext, jnp.asarray(coding.G1))[6: 6 + n_dbps] % 2
+        coded = jnp.stack([a, b], 1).reshape(-1).astype(jnp.uint8)
+        punct = coding.puncture(coded, rate.coding)
+        return ext[-6:], punct
+
+    def stage_map(state, coded_syms):
+        sym_idx = state
+        inter = interleave.interleave(coded_syms, n_cbps, n_bpsc)
+        syms = modulate.modulate(inter, n_bpsc)
+        pol = jnp.asarray(ofdm.PILOT_POLARITY, jnp.float32)[
+            (sym_idx + 1) % 127]
+        bins = jnp.zeros((64, 2), jnp.float32)
+        bins = bins.at[jnp.asarray(ofdm.DATA_BINS), :].set(syms)
+        p_re = jnp.asarray(ofdm.PILOT_VALS, jnp.float32) * pol
+        bins = bins.at[jnp.asarray(ofdm.PILOT_BINS), :].set(
+            jnp.stack([p_re, jnp.zeros_like(p_re)], axis=-1))
+        t = ofdm.ofdm_modulate(bins[None, :, :])[0]
+        return sym_idx + 1, t
+
+    return z.pipe(
+        z.map_accum(stage_scramble, np.int32(0),
+                    in_arity=n_dbps, out_arity=n_dbps, name="scramble"),
+        z.map_accum(stage_encode, np.zeros(6, np.int32),
+                    in_arity=n_dbps, out_arity=n_cbps, name="encode"),
+        z.map_accum(stage_map, np.int32(0),
+                    in_arity=n_cbps, out_arity=80, name="map_ofdm_ifft"),
+    )
