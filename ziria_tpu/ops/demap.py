@@ -1,0 +1,67 @@
+"""Soft demapper: equalized symbols -> per-bit LLRs.
+
+Counterpart of the reference RX's per-rate soft demapping blocks
+(SURVEY.md §2.3). Max-log approximate LLRs for the 802.11 Gray
+constellations, fully vectorized over subcarriers/symbols/frames; the
+channel gain |H|^2 weights each subcarrier's reliability so the Viterbi
+metric is SNR-aware after zero-forcing equalization.
+
+Sign convention matches ops/viterbi: positive LLR = bit more likely 1.
+Level-domain formulas (y = equalized amplitude in integer level units):
+
+    axis bit 0 (sign):        y
+    axis bit 1 (16/64-QAM):   2 - |y|        (16-QAM)  /  4 - |y| (64-QAM)
+    axis bit 2 (64-QAM):      2 - ||y| - 4|
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_NORM = {1: 1.0, 2: np.sqrt(2.0), 4: np.sqrt(10.0), 6: np.sqrt(42.0)}
+
+
+def demap(syms, n_bpsc: int, gain=None) -> jnp.ndarray:
+    """(..., m, 2) equalized pair symbols -> (..., m*n_bpsc) LLRs.
+
+    gain: optional (..., m) per-symbol reliability weight (|H|^2 after
+    zero-forcing); defaults to 1.
+    """
+    syms = jnp.asarray(syms, jnp.float32)
+    i = syms[..., 0] * _NORM[n_bpsc]
+    q = syms[..., 1] * _NORM[n_bpsc]
+    if n_bpsc == 1:
+        bits = i[..., None]
+    elif n_bpsc == 2:
+        bits = jnp.stack([i, q], axis=-1)
+    elif n_bpsc == 4:
+        bits = jnp.stack([i, 2.0 - jnp.abs(i),
+                          q, 2.0 - jnp.abs(q)], axis=-1)
+    elif n_bpsc == 6:
+        bits = jnp.stack([i, 4.0 - jnp.abs(i), 2.0 - jnp.abs(jnp.abs(i) - 4.0),
+                          q, 4.0 - jnp.abs(q), 2.0 - jnp.abs(jnp.abs(q) - 4.0)],
+                         axis=-1)
+    else:
+        raise ValueError(f"unsupported n_bpsc {n_bpsc}")
+    if gain is not None:
+        bits = bits * jnp.asarray(gain, jnp.float32)[..., None]
+    return bits.reshape(syms.shape[:-2] + (syms.shape[-2] * n_bpsc,))
+
+
+def np_demap_hard_ref(syms_c: np.ndarray, n_bpsc: int) -> np.ndarray:
+    """Independent hard-decision oracle: nearest constellation point via
+    the modulator's own tables, returning its bit label. Tests only."""
+    from ziria_tpu.ops.modulate import np_modulate_ref
+    pts = []
+    labels = []
+    for v in range(1 << n_bpsc):
+        bits = np.array([(v >> (n_bpsc - 1 - k)) & 1
+                         for k in range(n_bpsc)], np.uint8)
+        pts.append(np_modulate_ref(bits, n_bpsc)[0])
+        labels.append(bits)
+    pts = np.asarray(pts)
+    out = []
+    for s in np.asarray(syms_c).reshape(-1):
+        out.append(labels[int(np.argmin(np.abs(pts - s)))])
+    return np.concatenate(out)

@@ -1,0 +1,95 @@
+"""Packet detection, CFO estimation/correction, channel estimation.
+
+Counterpart of the reference RX's front half (SURVEY.md §2.3, §3.4):
+packet detect via STS autocorrelation, coarse/fine CFO from STS/LTS
+lag products, channel estimation from the two LTS symbols. All in pair
+representation, all expressed as whole-array ops (cumulative sums for
+sliding correlations) so a frame's worth of samples is one fused graph.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ziria_tpu.ops import cplx
+from ziria_tpu.ops.ofdm import LTS_FREQ, N_FFT
+
+
+def _sliding_sum(x, w: int):
+    """Sliding window sums along axis 0: out[k] = sum(x[k:k+w])."""
+    c = jnp.cumsum(x, axis=0)
+    c = jnp.concatenate([jnp.zeros_like(c[:1]), c], axis=0)
+    return c[w:] - c[:-w]
+
+
+def sts_autocorr(samples, window: int = 48):
+    """Normalized lag-16 autocorrelation metric over a sample stream.
+
+    samples: (n, 2). Returns (metric (n-16-window+1,), corr pairs).
+    metric ~ 1 inside the short preamble's periodic region.
+    """
+    x = jnp.asarray(samples, jnp.float32)
+    a, b = x[:-16], x[16:]
+    prod = cplx.cmul_conj(b, a)            # r[k+16] * conj(r[k])
+    corr = _sliding_sum(prod, window)      # (n-16-window+1, 2)
+    energy = _sliding_sum(cplx.cabs2(b), window)
+    metric = jnp.sqrt(cplx.cabs2(corr)) / (energy + 1e-9)
+    return metric, corr
+
+
+def detect_packet(samples, window: int = 48, threshold: float = 0.75):
+    """Return (detected?, start_index) — the first index where the STS
+    autocorrelation metric crosses the threshold (start of the plateau).
+    Data-dependent only in the returned index, so it jits (lax-friendly
+    argmax over a boolean ramp)."""
+    metric, _ = sts_autocorr(samples, window)
+    above = metric > threshold
+    detected = jnp.any(above)
+    start = jnp.argmax(above).astype(jnp.int32)  # first True
+    return detected, start
+
+
+def estimate_cfo_sts(samples, n_pairs: int = 96):
+    """CFO estimate (rad/sample) from the short preamble region of an
+    aligned frame (samples[0] = frame start). Uses lag-16 products over
+    the STS body."""
+    x = jnp.asarray(samples, jnp.float32)[: 160]
+    prod = cplx.cmul_conj(x[16:16 + n_pairs], x[:n_pairs])
+    s = jnp.sum(prod, axis=0)
+    return cplx.cangle(s) / 16.0
+
+
+def estimate_cfo_lts(samples):
+    """Fine CFO from the two aligned LTS symbols (samples[0] = frame
+    start; LTS symbols at 192..256..320). Lag-64 product."""
+    x = jnp.asarray(samples, jnp.float32)
+    l1 = x[192:256]
+    l2 = x[256:320]
+    s = jnp.sum(cplx.cmul_conj(l2, l1), axis=0)
+    return cplx.cangle(s) / 64.0
+
+
+def correct_cfo(samples, eps):
+    """Multiply samples by e^{-j*eps*n}."""
+    x = jnp.asarray(samples, jnp.float32)
+    n = jnp.arange(x.shape[0], dtype=jnp.float32)
+    rot = cplx.cexp(-eps * n)
+    return cplx.cmul(x, rot)
+
+
+def estimate_channel(samples):
+    """Channel estimate from the two LTS symbols of an aligned,
+    CFO-corrected frame (samples[0] = frame start). Returns H as
+    (64, 2) pairs (zero on unused bins), normalized to the same scale
+    ofdm_demodulate uses, so H == 1 for an identity channel."""
+    from ziria_tpu.ops.ofdm import TIME_SCALE
+
+    x = jnp.asarray(samples, jnp.float32)
+    l1 = cplx.fft_pair(x[192:256])
+    l2 = cplx.fft_pair(x[256:320])
+    avg = (l1 + l2) * (0.5 / TIME_SCALE)
+    # known LTS is real +-1 (0 on unused): H = Y / X = Y * X (X real unit)
+    ref = np.zeros(N_FFT, np.float32)
+    ref[(np.arange(-26, 27) % N_FFT)] = LTS_FREQ.astype(np.float32)
+    return avg * jnp.asarray(ref)[:, None]

@@ -1,0 +1,134 @@
+"""Soft-decision Viterbi decoder for the 802.11 K=7 convolutional code.
+
+Counterpart of the reference's SORA Viterbi brick (`sora_ext_viterbi.c`,
+SSE-parallel ACS — SURVEY.md §2.2), the hottest RX kernel. TPU-native
+design:
+
+- the 64-state trellis (state = the 6 most recent input bits,
+  newest in the MSB) is precomputed as numpy edge tables at module load;
+- add-compare-select runs as one ``lax.scan`` over time with the state
+  axis fully vectorized (64-wide VPU ops), and *frames batched via
+  vmap* — the reference parallelizes ACS across SSE lanes, we
+  parallelize across states x frames;
+- traceback is a second (backward) scan over the stored per-step
+  decisions; metrics are renormalized every step by subtracting the max
+  to keep f32 well-conditioned.
+
+Soft input: LLR-like reliabilities, positive = bit more likely 1 (so a
+hard bit b maps to 2b-1). Punctured positions carry 0 (erasure), which
+``ops.coding.depuncture`` inserts.
+
+A Pallas VMEM-resident kernel of the same trellis lives in
+ops/viterbi_pallas.py (bench path); this module is the reference
+implementation both backends are tested against.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ziria_tpu.ops.coding import G0, G1, K
+
+N_STATES = 64
+
+
+def _edge_tables():
+    """For each next-state t and decision d in {0,1}: predecessor state
+    and the two coded output bits on that edge (as +-1 floats)."""
+    pred = np.zeros((N_STATES, 2), np.int32)
+    out_a = np.zeros((N_STATES, 2), np.float32)
+    out_b = np.zeros((N_STATES, 2), np.float32)
+    for t in range(N_STATES):
+        b = t >> 5                     # input bit of any edge into t
+        for d in range(2):             # d = low bit of the predecessor
+            s = ((t & 31) << 1) | d
+            pred[t, d] = s
+            # window [x_k, x_{k-1..k-6}] = [b] + bits of s (MSB=newest)
+            window = [b] + [(s >> (5 - i)) & 1 for i in range(6)]
+            a = sum(g * w for g, w in zip(G0, window)) % 2
+            bb = sum(g * w for g, w in zip(G1, window)) % 2
+            out_a[t, d] = 2.0 * a - 1.0
+            out_b[t, d] = 2.0 * bb - 1.0
+    return pred, out_a, out_b
+
+
+_PRED, _OUT_A, _OUT_B = _edge_tables()
+
+
+def viterbi_decode(llrs, n_bits: int = None) -> jnp.ndarray:
+    """Decode soft values.
+
+    llrs: (2T,) or (T, 2) float — reliabilities for coded bits (A_k, B_k);
+    positive means "more likely 1". Assumes the encoder started in state
+    0 (initial metric pins state 0); traceback starts from the
+    highest-metric end state — for a zero-terminated (802.11 tail)
+    stream that IS state 0 at reasonable SNR, and argmax degrades more
+    gracefully when it isn't. Returns (T,) decoded bits; the caller
+    slices off tail/pad (or passes n_bits to do it here).
+    """
+    llrs = jnp.asarray(llrs, jnp.float32)
+    if llrs.ndim == 1:
+        llrs = llrs.reshape(-1, 2)
+    T = llrs.shape[0]
+
+    pred = jnp.asarray(_PRED)
+    out_a = jnp.asarray(_OUT_A)
+    out_b = jnp.asarray(_OUT_B)
+
+    neg = jnp.float32(-1e30)
+    init = jnp.full((N_STATES,), neg).at[0].set(0.0)
+
+    def acs(metrics, llr):
+        # candidate metric for each (next-state, decision)
+        cand = metrics[pred] + out_a * llr[0] + out_b * llr[1]  # (64, 2)
+        best = jnp.argmax(cand, axis=1).astype(jnp.uint8)
+        new = jnp.max(cand, axis=1)
+        new = new - jnp.max(new)  # renormalize
+        return new, best
+
+    metrics, decisions = jax.lax.scan(acs, init, llrs)  # decisions (T, 64)
+
+    end_state = jnp.argmax(metrics).astype(jnp.int32)
+
+    def back(state, dec):
+        bit = (state >> 5).astype(jnp.uint8)
+        prev = pred[state, dec[state]]
+        return prev, bit
+
+    _, bits_rev = jax.lax.scan(back, end_state, decisions, reverse=True)
+    bits = bits_rev  # scan(reverse=True) already yields outputs in order
+    if n_bits is not None:
+        bits = bits[:n_bits]
+    return bits
+
+
+def viterbi_decode_bits(coded_bits, n_bits: int = None) -> jnp.ndarray:
+    """Hard-decision convenience: 0/1 coded bits -> decoded bits."""
+    b = jnp.asarray(coded_bits, jnp.float32)
+    return viterbi_decode(2.0 * b - 1.0, n_bits)
+
+
+def np_viterbi_ref(llrs: np.ndarray) -> np.ndarray:
+    """Independent oracle: dict-based python Viterbi. Tests only."""
+    llrs = np.asarray(llrs, np.float64).reshape(-1, 2)
+    T = llrs.shape[0]
+    metrics = {0: 0.0}
+    paths = {0: []}
+    for k in range(T):
+        new_m, new_p = {}, {}
+        for s, m in metrics.items():
+            for b in (0, 1):
+                window = [b] + [(s >> (5 - i)) & 1 for i in range(6)]
+                a = sum(g * w for g, w in zip(G0, window)) % 2
+                bb = sum(g * w for g, w in zip(G1, window)) % 2
+                t = (b << 5) | (s >> 1)
+                cand = (m + (2 * a - 1) * llrs[k, 0]
+                        + (2 * bb - 1) * llrs[k, 1])
+                if t not in new_m or cand > new_m[t]:
+                    new_m[t] = cand
+                    new_p[t] = paths[s] + [b]
+        metrics, paths = new_m, new_p
+    best = max(metrics, key=metrics.get)
+    return np.array(paths[best], np.uint8)

@@ -1,0 +1,64 @@
+"""Channel impairment models for loopback testing (pair format).
+
+The reference tests its RX against TX output passed through file-based
+golden streams (SURVEY.md §4); real-channel impairments came from
+SORA/BladeRF hardware. Here the channel is synthetic and explicit: AWGN,
+carrier frequency offset, integer delay (with noise padding), phase
+offset, and multipath FIR — everything jax, batchable over frames.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ziria_tpu.ops import cplx
+
+
+def awgn(key, samples, snr_db: float) -> jnp.ndarray:
+    """Add complex white noise at the given SNR (dB) relative to the
+    average sample power."""
+    x = jnp.asarray(samples, jnp.float32)
+    p_sig = jnp.mean(cplx.cabs2(x))
+    p_noise = p_sig / (10.0 ** (snr_db / 10.0))
+    noise = jax.random.normal(key, x.shape) * jnp.sqrt(p_noise / 2.0)
+    return x + noise
+
+
+def apply_cfo(samples, eps: float) -> jnp.ndarray:
+    """Rotate samples by e^{+j*eps*n} (eps radians/sample)."""
+    x = jnp.asarray(samples, jnp.float32)
+    n = jnp.arange(x.shape[0], dtype=jnp.float32)
+    return cplx.cmul(x, cplx.cexp(eps * n))
+
+
+def apply_phase(samples, theta: float) -> jnp.ndarray:
+    x = jnp.asarray(samples, jnp.float32)
+    return cplx.cmul(x, jnp.broadcast_to(cplx.cexp(jnp.float32(theta)),
+                                         x.shape))
+
+
+def delay(key, samples, n_before: int, n_after: int = 0,
+          noise_db: float = -30.0) -> jnp.ndarray:
+    """Pad the frame with low-level noise before/after (models idle air
+    time around a detected packet)."""
+    x = jnp.asarray(samples, jnp.float32)
+    p_sig = jnp.mean(cplx.cabs2(x))
+    amp = jnp.sqrt(p_sig * 10.0 ** (noise_db / 10.0) / 2.0)
+    pad = jax.random.normal(key, (n_before + n_after, 2)) * amp
+    return jnp.concatenate([pad[:n_before], x, pad[n_before:]], axis=0)
+
+
+def multipath(samples, taps_pair) -> jnp.ndarray:
+    """Complex FIR channel: taps_pair (L, 2). Causal, same length out."""
+    x = jnp.asarray(samples, jnp.float32)
+    t = jnp.asarray(taps_pair, jnp.float32)
+    n = x.shape[0]
+
+    def conv(u, v):
+        return jnp.convolve(u, v, precision="highest")[:n]
+
+    re = conv(x[:, 0], t[:, 0]) - conv(x[:, 1], t[:, 1])
+    im = conv(x[:, 0], t[:, 1]) + conv(x[:, 1], t[:, 0])
+    return jnp.stack([re, im], axis=-1)

@@ -1,0 +1,88 @@
+/* Soft-decision Viterbi decoder, K=7 (g0=133o, g1=171o), 64 states.
+ *
+ * Native CPU reference/baseline implementation — the role the SORA SSE
+ * Viterbi brick plays in the reference system (SURVEY.md §2.2): a
+ * C-speed decoder the accelerator path is benchmarked against, and the
+ * host-side fallback decoder for the runtime. Loaded via ctypes
+ * (ziria_tpu/runtime/native.py). Plain portable C; the compiler
+ * auto-vectorizes the 64-wide ACS inner loops.
+ *
+ * State convention matches ziria_tpu/ops/viterbi.py: state = the 6 most
+ * recent input bits, newest in bit 5; edge into state t consumes input
+ * bit t>>5 from predecessor ((t&31)<<1)|d.
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define N_STATES 64
+#define NEG_INF (-1e30f)
+
+static int g_init = 0;
+static int pred[N_STATES][2];
+static float out_a[N_STATES][2];
+static float out_b[N_STATES][2];
+
+static const int G0[7] = {1, 0, 1, 1, 0, 1, 1}; /* 133 octal */
+static const int G1[7] = {1, 1, 1, 1, 0, 0, 1}; /* 171 octal */
+
+static void init_tables(void) {
+    if (g_init) return;
+    for (int t = 0; t < N_STATES; t++) {
+        int b = t >> 5;
+        for (int d = 0; d < 2; d++) {
+            int s = ((t & 31) << 1) | d;
+            pred[t][d] = s;
+            int w[7];
+            w[0] = b;
+            for (int i = 0; i < 6; i++) w[i + 1] = (s >> (5 - i)) & 1;
+            int a = 0, bb = 0;
+            for (int i = 0; i < 7; i++) {
+                a ^= G0[i] & w[i];
+                bb ^= G1[i] & w[i];
+            }
+            out_a[t][d] = 2.0f * a - 1.0f;
+            out_b[t][d] = 2.0f * bb - 1.0f;
+        }
+    }
+    g_init = 1;
+}
+
+/* llrs: T pairs (A,B); out: T decoded bits. Returns 0 on success. */
+int ziria_viterbi_decode(const float *llrs, int64_t T, uint8_t *out) {
+    init_tables();
+    float m[N_STATES], nm[N_STATES];
+    uint8_t *dec = (uint8_t *)malloc((size_t)T * N_STATES);
+    if (!dec) return -1;
+    for (int s = 0; s < N_STATES; s++) m[s] = NEG_INF;
+    m[0] = 0.0f;
+
+    for (int64_t k = 0; k < T; k++) {
+        const float la = llrs[2 * k], lb = llrs[2 * k + 1];
+        float best = NEG_INF;
+        uint8_t *dk = dec + k * N_STATES;
+        for (int t = 0; t < N_STATES; t++) {
+            float c0 = m[pred[t][0]] + out_a[t][0] * la + out_b[t][0] * lb;
+            float c1 = m[pred[t][1]] + out_a[t][1] * la + out_b[t][1] * lb;
+            int d = c1 > c0;
+            float c = d ? c1 : c0;
+            dk[t] = (uint8_t)d;
+            nm[t] = c;
+            if (c > best) best = c;
+        }
+        for (int t = 0; t < N_STATES; t++) m[t] = nm[t] - best;
+    }
+
+    int state = 0;
+    float best = NEG_INF;
+    for (int t = 0; t < N_STATES; t++)
+        if (m[t] > best) { best = m[t]; state = t; }
+
+    for (int64_t k = T - 1; k >= 0; k--) {
+        out[k] = (uint8_t)(state >> 5);
+        state = pred[state][dec[k * N_STATES + state]];
+    }
+    free(dec);
+    return 0;
+}

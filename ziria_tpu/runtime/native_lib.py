@@ -1,0 +1,65 @@
+"""ctypes loader for the native runtime library.
+
+The reference's runtime is C (`csrc/` — SURVEY.md §2.2); this module
+holds the framework's native CPU components: currently the K=7 Viterbi
+decoder (SORA-brick analogue), used as the honest C baseline in
+bench.py and as a host-side fallback decoder. Builds on demand with
+``make`` (gcc); everything degrades gracefully to the numpy/jax paths
+if no toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SO = os.path.join(_DIR, "libziria_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def load(build: bool = True) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or (_tried and not build):
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO) and build:
+        try:
+            subprocess.run(["make", "-C", _DIR], check=True,
+                           capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+    if not os.path.exists(_SO):
+        return None
+    lib = ctypes.CDLL(_SO)
+    lib.ziria_viterbi_decode.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8)]
+    lib.ziria_viterbi_decode.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def viterbi_decode_native(llrs: np.ndarray) -> np.ndarray:
+    """Native C Viterbi: llrs (T,2) or (2T,) float32 -> (T,) uint8 bits.
+    Raises RuntimeError if the library is unavailable."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no gcc/make?)")
+    llrs = np.ascontiguousarray(np.asarray(llrs, np.float32).reshape(-1, 2))
+    T = llrs.shape[0]
+    out = np.zeros(T, np.uint8)
+    rc = lib.ziria_viterbi_decode(
+        llrs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int64(T),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        raise RuntimeError(f"native viterbi failed rc={rc}")
+    return out
