@@ -20,13 +20,25 @@ import numpy as np
 
 
 def _block(out):
+    """Force completion of everything queued before `out`.
+
+    block_until_ready() under the axon tunnel returns before the device
+    is actually done (measured: it reported rates exceeding HBM
+    bandwidth); a tiny device->host copy of the result is an honest
+    fence because transfers are ordered after the producing computation.
+    """
     import jax
-    jax.tree.map(
-        lambda a: a.block_until_ready()
-        if hasattr(a, "block_until_ready") else a, out)
+    leaves = [a for a in jax.tree.leaves(out) if hasattr(a, "ndim")]
+    for a in leaves[-1:]:
+        np.asarray(a.ravel()[:1] if a.ndim else a)
 
 
 def _time(fn, *args, reps=5):
+    """Average seconds per call: queue `reps` async calls, fence once.
+
+    reps amortizes the host<->device round-trip (~70 ms through the axon
+    tunnel) which would otherwise dominate millisecond-scale kernels.
+    """
     _block(fn(*args))  # warm-up / compile, fully drained before timing
     t0 = time.perf_counter()
     for _ in range(reps):
@@ -105,7 +117,8 @@ def np_rx_decode(frame, rate, n_sym, n_psdu_bits):
             state = _PRED[state, decisions[k, state]]
 
     seq = np.resize(np_lfsr_sequence_127(np.ones(7, np.uint8)), bits.size)
-    return bits ^ seq  # descramble (fixed seed stand-in, same op count)
+    clear = bits ^ seq  # descramble (fixed seed stand-in, same op count)
+    return clear[16: 16 + n_psdu_bits]  # 16 SERVICE bits, then the PSDU
 
 
 def main():
@@ -132,13 +145,15 @@ def main():
     want = np.asarray(bytes_to_bits(psdu))
     assert np.array_equal(np.asarray(got), want), "bench RX decode mismatch"
 
-    # --- TPU: batched frames
-    B = 64
+    # --- TPU: batched frames through the Pallas-Viterbi fast path
+    B = 128
     frames = jnp.asarray(np.broadcast_to(frame, (B,) + frame.shape).copy())
 
-    decode = jax.jit(jax.vmap(
-        lambda f: rx.decode_data_static(f, rate, n_sym, n_psdu_bits)[0]))
-    t_tpu = _time(decode, frames)
+    decode = jax.jit(
+        lambda f: rx.decode_data_batch(f, rate, n_sym, n_psdu_bits)[0])
+    got_b = np.asarray(decode(frames))
+    assert np.array_equal(got_b[0], want) and np.array_equal(got_b[-1], want)
+    t_tpu = _time(decode, frames, reps=50)
     sps = B * frame_len / t_tpu
 
     # --- numpy baseline (single frame, scaled)

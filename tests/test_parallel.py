@@ -1,0 +1,119 @@
+"""Stage-parallel (|>>>|) and frame-batching (dp) execution on the
+8-virtual-device CPU mesh — outputs must equal the fused single-device
+lowering (the reference's invariant: |>>>| output == >>> output)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ziria_tpu as z
+from ziria_tpu.backend.lower import lower
+from ziria_tpu.core import ir
+from ziria_tpu.parallel import (data_parallel, frame_mesh,
+                                lower_stage_parallel, shard_batch)
+from jax.sharding import Mesh
+
+
+def _mesh(n, axis="pp"):
+    devs = jax.devices()[:n]
+    return Mesh(np.array(devs), (axis,))
+
+
+def _run_fused(comp, xs_chunks):
+    lo = lower(comp, width=1)
+    carry = lo.init_carry
+    outs = []
+    for c in xs_chunks:
+        carry, y = jax.jit(lo.step)(carry, c)
+        outs.append(np.asarray(y))
+    return np.stack(outs)
+
+
+def test_two_stage_matches_fused():
+    a = z.zmap(lambda x: x * 2.0, name="dbl")
+    b = z.zmap(lambda x: x + 1.0, name="inc")
+    comp = z.par_pipe(a, b)
+
+    pp = lower_stage_parallel(comp, _mesh(2), width=4)
+    M = 6
+    xs = np.arange(M * pp.take, dtype=np.float32).reshape(M, pp.take)
+    got = np.asarray(pp.run(xs))
+    want = _run_fused(ir.Pipe(a, b), jnp.asarray(xs))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_stateful_stage_carries_across_macro_steps():
+    # stage 1: running sum (stateful); stage 2: negate
+    acc = z.map_accum(lambda s, x: (s + x, s + x), 0.0, name="cumsum")
+    neg = z.zmap(lambda x: -x, name="neg")
+    comp = z.par_pipe(acc, neg)
+
+    pp = lower_stage_parallel(comp, _mesh(2), width=3)
+    M = 5
+    xs = np.arange(M * pp.take, dtype=np.float32).reshape(M, pp.take)
+    got = np.asarray(pp.run(xs)).reshape(-1)
+    want = -np.cumsum(xs.reshape(-1))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_stateful_downstream_stage_ignores_fill_bubbles():
+    # stage 2 is stateful with a transition that is NOT identity on zero
+    # input — fill bubbles must not advance its state (regression: the
+    # first macro step used to step downstream carries on zeros)
+    neg = z.zmap(lambda x: -x, name="neg")
+    ctr = z.map_accum(lambda s, x: (s + 1.0, x + s), 0.0, name="ctr")
+    comp = z.par_pipe(neg, ctr)
+
+    pp = lower_stage_parallel(comp, _mesh(2), width=2)
+    M = 4
+    xs = np.arange(M * pp.take, dtype=np.float32).reshape(M, pp.take)
+    got = np.asarray(pp.run(xs)).reshape(-1)
+    flat = xs.reshape(-1)
+    want = -flat + np.arange(flat.size, dtype=np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_rate_mismatched_stages():
+    # stage 1 emits pairs, stage 2 sums pairs -> rates 1:2 vs 2:1
+    dup = z.repeat(z.let("x", z.take,
+                         z.emits(lambda e: jnp.stack([e["x"], e["x"]]), 2)))
+    pair_sum = z.repeat(z.let("p", z.takes(2),
+                              z.emit1(lambda e: e["p"][0] + e["p"][1])))
+    comp = z.par_pipe(dup, pair_sum)
+
+    pp = lower_stage_parallel(comp, _mesh(2), width=2)
+    M = 4
+    xs = np.arange(M * pp.take, dtype=np.float32).reshape(M, pp.take)
+    got = np.asarray(pp.run(xs)).reshape(-1)
+    np.testing.assert_allclose(got, 2.0 * xs.reshape(-1), rtol=1e-6)
+
+
+def test_four_stages_int_dtype_preserved():
+    stages = [z.zmap(lambda x, _k=k: x + _k, name=f"s{k}") for k in range(4)]
+    comp = z.par_pipe(*stages)
+    pp = lower_stage_parallel(
+        comp, _mesh(4), width=2,
+        in_item=jax.ShapeDtypeStruct((), jnp.int32))
+    M = 3
+    xs = np.arange(M * pp.take, dtype=np.int32).reshape(M, pp.take)
+    got = np.asarray(pp.run(xs))
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, xs + 6)
+
+
+def test_wrong_segment_count_raises():
+    comp = z.par_pipe(z.zmap(lambda x: x), z.zmap(lambda x: x))
+    from ziria_tpu.backend.lower import LowerError
+    with pytest.raises(LowerError):
+        lower_stage_parallel(comp, _mesh(3), width=1)
+
+
+def test_data_parallel_frames():
+    mesh = frame_mesh(8)
+    B = 16
+    x = np.arange(B * 32, dtype=np.float32).reshape(B, 32)
+    xs = shard_batch(mesh, x)
+    fn = data_parallel(lambda a: (a * 2).sum(axis=-1), mesh)
+    got = np.asarray(fn(xs))
+    np.testing.assert_allclose(got, (x * 2).sum(-1), rtol=1e-6)

@@ -1,0 +1,10 @@
+from ziria_tpu.parallel.batch import data_parallel, frame_mesh, shard_batch
+from ziria_tpu.parallel.stages import PPLowered, lower_stage_parallel
+
+__all__ = [
+    "PPLowered",
+    "data_parallel",
+    "frame_mesh",
+    "lower_stage_parallel",
+    "shard_batch",
+]

@@ -1,0 +1,205 @@
+"""Stage-parallel pipeline execution: the `|>>>|` analogue on a mesh.
+
+The reference runs each `|>>>|` segment on its own core with SPSC
+"thread-separator" queues between (SURVEY.md §3.3 — the only concurrency
+boundary it has). TPU-native redesign: each segment is fused by the jit
+backend (backend/lower.py) and placed on one device of a mesh axis;
+chunks advance segment-to-segment with `lax.ppermute` over ICI — the
+queue becomes a register-to-register ring shift, and the whole
+software-pipelined loop is ONE `shard_map`-ped `lax.scan`.
+
+SPMD encoding of the MPMD pipeline:
+
+- every device holds the full tuple of segment carries but only evolves
+  its own (selected with `lax.switch` on `axis_index` — switch executes
+  a single branch, so there is no wasted compute);
+- inter-segment chunks live in a K-1 tuple of boundary "slots"; device k
+  fills slot k, the whole tuple ppermute-shifts k -> k+1 each macro
+  step, device k+1 reads slot k. Dtypes/shapes per boundary are
+  preserved exactly (no flatten-to-f32 carrier);
+- the last segment's output is broadcast with a masked `psum`, so the
+  scan's stacked output is replicated and the host reads it once.
+
+Latency/fill: with K segments, output m corresponds to input m-(K-1);
+the driver feeds K-1 trailing dummy chunks and trims the first K-1
+outputs (classic pipeline fill/drain bubbles).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ziria_tpu.core import ir
+from ziria_tpu.core.card import TCard, cardinality
+from ziria_tpu.backend.lower import Lowered, LowerError, lower
+
+
+def _lcm(a: int, b: int) -> int:
+    from math import gcd
+    return a * b // gcd(a, b)
+
+
+def _segment_widths(segs: Sequence[ir.Comp], width: int) -> list:
+    """Per-segment lowering widths that rate-match every boundary.
+
+    Each segment's own steady state consumes/produces (take_k, emit_k)
+    per iteration; the boundary between k and k+1 balances when
+    emit_k * w_k == take_{k+1} * w_{k+1} — the same SDF repetition
+    solve as core.card.steady_state, one level up.
+    """
+    rates = []
+    for s in segs:
+        c = cardinality(s)
+        if not isinstance(c, TCard) or c.i == 0 or c.o == 0:
+            raise LowerError(
+                f"stage-parallel segment {s.label()} needs a static "
+                f"transformer rate with nonzero input and output")
+        rates.append(c)
+    w = [1] * len(segs)
+    for k in range(len(segs) - 1):
+        prod = rates[k].o * w[k]
+        need = rates[k + 1].i
+        l = _lcm(prod, need)
+        if l // prod != 1:
+            for j in range(k + 1):
+                w[j] *= l // prod
+            prod = l
+        w[k + 1] = prod // need
+    return [wi * width for wi in w]
+
+
+@dataclass
+class PPLowered:
+    """A stage-parallel pipeline bound to a mesh axis.
+
+    ``run(xs)``: xs (M, take, *item) -> (M, emit, *out_item); M macro
+    steps of input, same M of output (fill/drain handled internally).
+    """
+
+    run: Callable
+    take: int
+    emit: int
+    n_stages: int
+    labels: Tuple[str, ...]
+
+
+def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
+                         in_item: jax.ShapeDtypeStruct = None,
+                         width: int = 1) -> PPLowered:
+    """Lower a ParPipe pipeline onto `mesh[axis]`, one segment per device.
+
+    `in_item` is the shape/dtype of ONE input stream item (default: f32
+    scalar). The number of ParPipe segments must equal the axis size.
+    """
+    segs = ir.par_segments(comp)
+    K = len(segs)
+    n_dev = mesh.shape[axis]
+    if K != n_dev:
+        raise LowerError(
+            f"{K} |>>>| segments but mesh axis {axis!r} has {n_dev} "
+            f"devices; split the pipeline to match (or batch frames over "
+            f"'dp' instead)")
+    if in_item is None:
+        in_item = jax.ShapeDtypeStruct((), jnp.float32)
+
+    widths = _segment_widths(segs, width)
+    lows = [lower(s, width=w) for s, w in zip(segs, widths)]
+
+    # probe boundary chunk shapes with abstract evaluation
+    chunk_structs = []
+    cur = jax.ShapeDtypeStruct((lows[0].take,) + tuple(in_item.shape),
+                               in_item.dtype)
+    for lo in lows:
+        _, out = jax.eval_shape(lo.step, lo.init_carry, cur)
+        chunk_structs.append(cur)
+        cur = jax.ShapeDtypeStruct(tuple(out.shape), out.dtype)
+    out_struct = cur
+
+    def zeros_like_struct(s):
+        return jnp.zeros(s.shape, s.dtype)
+
+    init_carries = tuple(lo.init_carry for lo in lows)
+    init_slots = tuple(zeros_like_struct(chunk_structs[k + 1])
+                       for k in range(K - 1))
+    perm = [(k, k + 1) for k in range(K - 1)]
+
+    def make_branch(k):
+        lo = lows[k]
+
+        def br(operand):
+            carries, slots, x_in, m = operand
+            my_in = x_in if k == 0 else slots[k - 1]
+
+            # Input m reaches segment k at macro step m+k, so steps < k
+            # carry fill bubbles (zeros): a stateful segment must NOT
+            # step its carry on them or it diverges from the fused >>>
+            # lowering. (Trailing drain bubbles also corrupt carries,
+            # but only after every real output has been produced.)
+            def live(cx):
+                c, out = lo.step(cx[0], cx[1])
+                return c, out
+
+            def bubble(cx):
+                return cx[0], zeros_like_struct(
+                    chunk_structs[k + 1] if k < K - 1 else out_struct)
+
+            c, out = lax.cond(m >= k, live, bubble, (carries[k], my_in))
+            carries = tuple(c if j == k else carries[j] for j in range(K))
+            if k < K - 1:
+                slots = tuple(out if j == k else slots[j]
+                              for j in range(K - 1))
+                final = zeros_like_struct(out_struct)
+            else:
+                final = out
+            return carries, slots, final
+
+        return br
+
+    branches = [make_branch(k) for k in range(K)]
+
+    def spmd(xs):
+        """Per-device program; xs replicated (M+K-1, take, *item)."""
+        idx = lax.axis_index(axis)
+
+        def macro(state, xm):
+            x, m = xm
+            carries, slots = state
+            carries, slots, final = lax.switch(
+                idx, branches, (carries, slots, x, m))
+            if K > 1:
+                slots = lax.ppermute(slots, axis, perm)
+            # replicate the tail device's output to everyone (exact in
+            # the native dtype; non-tail devices contribute zeros)
+            final = lax.psum(
+                jnp.where(idx == K - 1, final, jnp.zeros_like(final)),
+                axis)
+            return (carries, slots), final
+
+        steps = jnp.arange(xs.shape[0], dtype=jnp.int32)
+        (_, _), ys = lax.scan(macro, (init_carries, init_slots), (xs, steps))
+        return ys
+
+    spec = P(*([None] * (len(out_struct.shape) + 1)))
+    mapped = shard_map(spmd, mesh=mesh, in_specs=P(), out_specs=spec,
+                       check_vma=False)
+    jitted = jax.jit(mapped)
+
+    def run(xs):
+        xs = jnp.asarray(xs)
+        M = xs.shape[0]
+        if K > 1:  # trailing dummies flush the pipeline
+            pad = jnp.zeros((K - 1,) + xs.shape[1:], xs.dtype)
+            xs = jnp.concatenate([xs, pad], axis=0)
+        ys = jitted(xs)
+        return ys[K - 1:] if K > 1 else ys
+
+    return PPLowered(run=run, take=lows[0].take, emit=lows[-1].emit,
+                     n_stages=K, labels=tuple(s.label() for s in segs))

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ziria_tpu.ops import cplx, coding, demap as demap_mod, interleave, ofdm, \
-    scramble, sync, viterbi
+    scramble, sync, viterbi, viterbi_pallas
 from ziria_tpu.ops.crc import check_crc32
 from ziria_tpu.phy.wifi.params import (N_SERVICE_BITS, N_TAIL_BITS,
                                        RateParams, RATES,
@@ -79,14 +79,10 @@ def decode_signal(frame):
     return rate_bits, length, parity_ok
 
 
-def decode_data_static(frame, rate: RateParams, n_sym: int,
-                       n_psdu_bits: int):
-    """Fully-jitted DATA decode for a known rate/symbol count: aligned
-    CFO-corrected frame -> (psdu_bits, descrambled service bits).
-
-    The flagship fused graph: channel est + (n_sym x 64) matmul-FFT +
-    equalize + pilot track + demap + deinterleave + depuncture + Viterbi
-    + descramble in one jit."""
+def _decode_front(frame, rate: RateParams, n_sym: int):
+    """Aligned frame -> depunctured soft LLR pairs (T, 2): channel est +
+    (n_sym x 64) matmul-FFT + equalize + pilot track + demap +
+    deinterleave + depuncture — everything before the Viterbi."""
     H = sync.estimate_channel(frame)
     syms = frame[FRAME_DATA_START: FRAME_DATA_START + 80 * n_sym]
     bins = ofdm.ofdm_demodulate(syms.reshape(n_sym, 80, 2))
@@ -98,15 +94,46 @@ def decode_data_static(frame, rate: RateParams, n_sym: int,
                            gain=jnp.broadcast_to(gain, data.shape[:-1]))
     deint = interleave.deinterleave(
         llrs.reshape(-1), rate.n_cbps, rate.n_bpsc)
-    depunct = coding.depuncture(deint, rate.coding, fill=0.0)
-    bits = viterbi.viterbi_decode(depunct, n_bits=n_sym * rate.n_dbps)
+    return coding.depuncture(deint, rate.coding, fill=0.0).reshape(-1, 2)
+
+
+def _decode_back(bits, n_psdu_bits: int):
+    """Decoded bits -> (psdu_bits, descrambled service bits)."""
     seed = scramble.recover_seed(bits[:7])
     clear = scramble.descramble_bits(bits, seed)
     psdu = clear[N_SERVICE_BITS: N_SERVICE_BITS + n_psdu_bits]
     return psdu, clear[:N_SERVICE_BITS]
 
 
-def sync_frame(samples, search: int = 4096):
+def decode_data_static(frame, rate: RateParams, n_sym: int,
+                       n_psdu_bits: int):
+    """Fully-jitted DATA decode for a known rate/symbol count: aligned
+    CFO-corrected frame -> (psdu_bits, descrambled service bits).
+
+    The flagship fused graph: channel est + (n_sym x 64) matmul-FFT +
+    equalize + pilot track + demap + deinterleave + depuncture + Viterbi
+    + descramble in one jit."""
+    depunct = _decode_front(frame, rate, n_sym)
+    bits = viterbi.viterbi_decode(depunct, n_bits=n_sym * rate.n_dbps)
+    return _decode_back(bits, n_psdu_bits)
+
+
+def decode_data_batch(frames, rate: RateParams, n_sym: int,
+                      n_psdu_bits: int, interpret: bool = None):
+    """Batched DATA decode: (B, frame_len, 2) -> ((B, n_psdu_bits),
+    (B, 16)).
+
+    The TPU fast path: the per-frame front end (FFT/equalize/demap/...)
+    runs under vmap, then the whole batch hits the Pallas Viterbi kernel
+    with frames laid out across the 128 VPU lanes (~8x the vmapped
+    lax.scan ACS; see ops/viterbi_pallas.py)."""
+    dep = jax.vmap(lambda f: _decode_front(f, rate, n_sym))(frames)
+    bits = viterbi_pallas.viterbi_decode_batch(
+        dep, n_bits=n_sym * rate.n_dbps, interpret=interpret)
+    return jax.vmap(lambda b: _decode_back(b, n_psdu_bits))(bits)
+
+
+def sync_frame(samples):
     """Locate and align a frame in a sample stream: STS detection gate,
     LTS cross-correlation timing, coarse+fine CFO. Returns
     (found, frame_start_index, cfo_estimate). Fixed shapes -> jits."""

@@ -30,12 +30,15 @@ def load(build: bool = True) -> Optional[ctypes.CDLL]:
     if _lib is not None or (_tried and not build):
         return _lib
     _tried = True
-    if not os.path.exists(_SO) and build:
+    if build:
+        # always delegate to make: it no-ops when the .so is newer than
+        # the sources and rebuilds after edits (the .so is built with
+        # -march=native, so it must never ship prebuilt — .gitignore'd)
         try:
             subprocess.run(["make", "-C", _DIR], check=True,
                            capture_output=True)
         except (OSError, subprocess.CalledProcessError):
-            return None
+            pass
     if not os.path.exists(_SO):
         return None
     lib = ctypes.CDLL(_SO)
