@@ -1,0 +1,79 @@
+"""AutoLUT pass: declared-domain maps become table gathers with
+identical semantics on both backends (the reference's --autolut flag
+invariance, SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ziria_tpu as z
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.core import ir
+from ziria_tpu.core.autolut import LutError, autolut, lut_map
+from ziria_tpu.core.opt import fold
+from ziria_tpu.interp.interp import run
+from ziria_tpu.utils.diff import assert_stream_eq
+
+
+def popcount8(x):
+    x = jnp.asarray(x, jnp.int32)
+    n = jnp.zeros_like(x)
+    for k in range(8):
+        n = n + ((x >> k) & 1)
+    return n
+
+
+def test_lut_matches_direct_both_backends():
+    prog = z.zmap(popcount8, name="popcount", in_domain=256)
+    lutted = autolut(prog)
+    assert isinstance(lutted, ir.Map) and lutted.label().startswith("lut[")
+    xs = np.arange(256, dtype=np.int32)
+    want = run(prog, list(xs)).out_array()
+    got_i = run(lutted, list(xs)).out_array()
+    assert_stream_eq(np.asarray(got_i), want, name="lut/interp")
+    got_j = run_jit(lutted, xs, width=4)
+    assert_stream_eq(np.asarray(got_j), want, name="lut/jit")
+
+
+def test_lut_in_pipeline_and_fuses():
+    prog = z.pipe(z.zmap(lambda x: (x * 7) % 64, name="hash"),
+                  z.zmap(popcount8, name="pc", in_domain=256))
+    lutted = fold(autolut(prog))
+    assert isinstance(lutted, ir.Map)  # fused to one stage
+    xs = np.arange(64, dtype=np.int32)
+    want = run(prog, list(xs)).out_array()
+    got = run_jit(lutted, xs, width=8)
+    assert_stream_eq(np.asarray(got), np.asarray(want))
+
+
+def test_vector_valued_lut():
+    # table rows are arrays: byte -> its 8 bits (used by scrambler-style
+    # bit unpacking)
+    def bits_of(x):
+        return (jnp.asarray(x, jnp.int32)[None] >> jnp.arange(8)) & 1
+
+    prog = z.zmap(bits_of, out_arity=1, name="bits", in_domain=256)
+    lutted = autolut(prog)
+    xs = np.array([0, 1, 170, 255], np.int32)
+    want = run(prog, list(xs)).out_array()
+    got = run(lutted, list(xs)).out_array()
+    assert_stream_eq(np.asarray(got), np.asarray(want))
+
+
+def test_bad_domains_rejected():
+    with pytest.raises(LutError):
+        lut_map(ir.Map(lambda x: x, 1, 1, "m", None))
+    with pytest.raises(LutError):
+        lut_map(ir.Map(lambda x: x, 1, 1, "m", 0))
+    with pytest.raises(LutError):
+        lut_map(ir.Map(lambda v: v, 2, 1, "m", 16))
+    with pytest.raises(LutError):
+        lut_map(ir.Map(lambda x: jnp.zeros((1 << 23,)) + x, 1, 1, "m", 2))
+
+
+def test_nested_structure_rewritten():
+    inner = z.repeat(z.let("x", z.take, z.emit1(lambda e: e["x"])))
+    prog = z.pipe(inner, z.zmap(popcount8, in_domain=256, name="pc"))
+    lutted = autolut(prog)
+    assert isinstance(lutted, ir.Pipe)
+    assert lutted.down.label().startswith("lut[")

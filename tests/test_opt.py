@@ -1,0 +1,125 @@
+"""Fold/fusion pass: structural assertions + the metamorphic invariant
+(folded program output == unfolded, on interpreter AND jit backend —
+the reference's flag-independence test pattern, SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import ziria_tpu as z
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.core import ir
+from ziria_tpu.core.opt import fold, fold_with_stats
+from ziria_tpu.interp.interp import run
+from ziria_tpu.utils.diff import assert_stream_eq
+
+
+def check_equiv(prog, xs, atol=0.0):
+    folded = fold(prog)
+    want = run(prog, list(xs)).out_array()
+    got_i = run(folded, list(xs)).out_array()
+    assert_stream_eq(np.asarray(got_i), want, atol=atol, name="fold/interp")
+    for p, tag in ((prog, "raw/jit"), (folded, "fold/jit")):
+        got = run_jit(p, np.asarray(xs), width=3)
+        assert_stream_eq(np.asarray(got), want, atol=atol, name=tag)
+    return folded
+
+
+def test_map_map_fuses_to_one_stage():
+    prog = z.pipe(z.zmap(lambda x: x + 1, name="inc"),
+                  z.zmap(lambda x: x * 3, name="tri"))
+    folded = check_equiv(prog, np.arange(24, dtype=np.int32))
+    assert isinstance(folded, ir.Map)  # single fused stage
+
+
+def test_repeat_take_emit_becomes_map():
+    prog = z.repeat(z.let("x", z.take, z.emit1(lambda e: e["x"] * 2)))
+    folded = check_equiv(prog, np.arange(12, dtype=np.int32))
+    assert isinstance(folded, ir.Map)
+    assert folded.in_arity == 1 and folded.out_arity == 1
+
+
+def test_repeat_takes_emits_becomes_map():
+    prog = z.repeat(z.let("v", z.takes(2),
+                          z.emits(lambda e: e["v"][::-1], 2)))
+    folded = check_equiv(prog, np.arange(20, dtype=np.int32))
+    assert isinstance(folded, ir.Map)
+    assert folded.in_arity == 2 and folded.out_arity == 2
+
+
+def test_repeat_take_emit_then_map_fuses_fully():
+    # fold turns the repeat into a Map, then map-map fusion collapses the
+    # whole pipeline into ONE stage
+    prog = z.pipe(
+        z.repeat(z.let("x", z.take, z.emit1(lambda e: e["x"] + 10))),
+        z.zmap(lambda x: x * 2, name="dbl"),
+        z.zmap(lambda x: x - 1, name="dec"))
+    folded = check_equiv(prog, np.arange(16, dtype=np.int32))
+    assert isinstance(folded, ir.Map)
+
+
+def test_map_accum_fusion():
+    def acc(s, x):
+        return s + x, s + x
+
+    prog = z.pipe(z.zmap(lambda x: x * 2, name="dbl"),
+                  z.map_accum(acc, 0, name="cumsum"),
+                  z.zmap(lambda x: x + 1, name="inc"))
+    folded = check_equiv(prog, np.arange(18, dtype=np.int32))
+    assert isinstance(folded, ir.MapAccum)  # one fused stateful stage
+
+
+def test_scoped_repeat_not_rewritten():
+    # the emit closure reads an outer ref -> R3 must NOT fire
+    prog = z.let_ref(
+        "g", 100,
+        z.repeat(z.let("x", z.take,
+                       z.emit1(lambda e: e["x"] + e["g"]))))
+    folded = fold(prog)
+    assert isinstance(folded, ir.LetRef)
+    assert isinstance(folded.body, ir.Repeat)  # untouched
+    want = run(prog, list(range(6))).out_array()
+    got = run(folded, list(range(6))).out_array()
+    assert_stream_eq(np.asarray(got), np.asarray(want))
+
+
+def test_const_branch_selected():
+    # a raw Branch is interpreter-only; folding selects the arm and
+    # thereby ENABLES jit lowering
+    prog = z.branch(True, z.zmap(lambda x: x + 1),
+                    z.zmap(lambda x: x - 1))
+    folded = fold(prog)
+    assert isinstance(folded, ir.Map)
+    xs = np.arange(10, dtype=np.int32)
+    want = run(prog, list(xs)).out_array()
+    got = run_jit(folded, xs, width=2)
+    assert_stream_eq(np.asarray(got), np.asarray(want))
+
+
+def test_fixpoint_terminates_and_counts():
+    stages = [z.zmap(lambda x, _k=k: x + _k) for k in range(6)]
+    prog = z.pipe(*stages)
+    folded, stats = fold_with_stats(prog)
+    assert isinstance(folded, ir.Map)
+    assert stats.rewrites >= 5
+
+
+def test_run_jit_optimize_flag():
+    prog = z.pipe(
+        z.repeat(z.let("x", z.take, z.emit1(lambda e: e["x"] + 5))),
+        z.zmap(lambda x: x * 2))
+    xs = np.arange(21, dtype=np.int32)
+    want = run(prog, list(xs)).out_array()
+    got = run_jit(prog, xs, width=2, optimize=True)
+    assert_stream_eq(np.asarray(got), np.asarray(want))
+
+
+def test_wifi_tx_pipeline_folds_and_matches():
+    # the real TX symbol pipeline still produces identical output
+    from ziria_tpu.phy.wifi import tx
+    prog = tx.tx_symbol_pipeline(24)
+    folded, stats = fold_with_stats(prog)
+    rate_bits = np.random.default_rng(0).integers(
+        0, 2, 5 * 96).astype(np.uint8)
+    want = run(prog, list(rate_bits)).out_array()
+    got = run(folded, list(rate_bits)).out_array()
+    assert_stream_eq(np.asarray(got), np.asarray(want), atol=1e-6)

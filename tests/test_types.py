@@ -1,0 +1,76 @@
+"""Stream-type checker: computer/transformer discipline (TcComp analogue)."""
+
+import jax.numpy as jnp
+import pytest
+
+import ziria_tpu as z
+from ziria_tpu.core import ir
+from ziria_tpu.core.types import CTy, TTy, ZiriaTypeError, typecheck
+
+
+def test_primitives_are_computers():
+    assert isinstance(typecheck(z.take), CTy)
+    assert isinstance(typecheck(z.takes(4)), CTy)
+    assert isinstance(typecheck(z.emit1(1.0)), CTy)
+    assert isinstance(typecheck(z.ret(0)), CTy)
+
+
+def test_map_family_are_transformers():
+    assert isinstance(typecheck(z.zmap(lambda x: x)), TTy)
+    assert isinstance(typecheck(z.map_accum(lambda s, x: (s, x), 0)), TTy)
+
+
+def test_repeat_of_computer_is_transformer():
+    c = z.let("x", z.take, z.emit1(lambda e: e["x"]))
+    assert isinstance(typecheck(c), CTy)
+    assert isinstance(typecheck(z.repeat(c)), TTy)
+
+
+def test_repeat_of_transformer_rejected():
+    with pytest.raises(ZiriaTypeError, match="repeat needs a computer"):
+        typecheck(z.repeat(z.zmap(lambda x: x)))
+
+
+def test_bind_of_transformer_rejected():
+    with pytest.raises(ZiriaTypeError, match="transformer"):
+        typecheck(z.let("x", z.zmap(lambda x: x), z.emit1(0)))
+
+
+def test_pipe_two_computers_rejected():
+    with pytest.raises(ZiriaTypeError, match="control position"):
+        typecheck(ir.Pipe(z.take, z.emit1(0)))
+
+
+def test_pipe_computer_transformer_is_computer():
+    # computer consuming the stream head, transformer downstream
+    c = z.let("x", z.takes(3), z.emits(lambda e: e["x"], 3))
+    t = z.zmap(lambda x: x * 2)
+    assert isinstance(typecheck(ir.Pipe(c, t)), CTy)
+    assert isinstance(typecheck(ir.Pipe(t, c)), CTy)
+    assert isinstance(typecheck(ir.Pipe(t, t)), TTy)
+
+
+def test_item_types_unified_through_pipe():
+    t1, t2 = z.zmap(lambda x: x), z.zmap(lambda x: x)
+    ty = typecheck(ir.Pipe(t1, t2))
+    assert isinstance(ty, TTy)
+
+
+def test_branch_kind_mismatch_rejected():
+    with pytest.raises(ZiriaTypeError, match="arms disagree"):
+        typecheck(z.branch(True, z.take, z.zmap(lambda x: x)))
+
+
+def test_for_body_must_be_computer():
+    ok = z.for_loop(4, z.let("x", z.take, z.emit1(lambda e: e["x"])))
+    assert isinstance(typecheck(ok), CTy)
+    with pytest.raises(ZiriaTypeError, match="for-loop body"):
+        typecheck(z.for_loop(4, z.zmap(lambda x: x)))
+
+
+def test_wifi_chains_typecheck():
+    # the real 802.11a TX stream program must pass the checker
+    from ziria_tpu.phy.wifi import tx
+    prog = tx.tx_symbol_pipeline(36)
+    ty = typecheck(prog)
+    assert isinstance(ty, (CTy, TTy))

@@ -52,3 +52,11 @@ from ziria_tpu.core.ir import (  # noqa: F401
     jax_block,
 )
 from ziria_tpu.core.card import Card, cardinality  # noqa: F401
+from ziria_tpu.core.types import (  # noqa: F401
+    CTy,
+    TTy,
+    ZiriaTypeError,
+    typecheck,
+)
+from ziria_tpu.core.opt import fold, fold_with_stats  # noqa: F401
+from ziria_tpu.core.autolut import autolut  # noqa: F401

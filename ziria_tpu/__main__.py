@@ -1,0 +1,3 @@
+from ziria_tpu.runtime.cli import main
+
+raise SystemExit(main())

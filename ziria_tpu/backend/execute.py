@@ -36,9 +36,17 @@ def _jit_scan(lowered: Lowered):
 
 
 def run_jit(comp: ir.Comp, inputs, width: Optional[int] = None,
-            target_items: int = 8192) -> np.ndarray:
+            target_items: int = 8192, optimize: bool = False) -> np.ndarray:
     """Run pipeline `comp` over `inputs` (array, leading axis = stream) on
-    the jit backend; returns the output stream as a numpy array."""
+    the jit backend; returns the output stream as a numpy array.
+
+    `optimize=True` runs the fold/fusion pass (core/opt.py) first — the
+    reference's `--fold` flag; output is invariant (tested) but folded
+    programs can lower where raw ones can't (const branches) and fuse to
+    fewer stages."""
+    if optimize:
+        from ziria_tpu.core.opt import fold
+        comp = fold(comp)
     inputs = np.asarray(inputs)
     big = lower(comp, width=width, target_items=target_items)
     n_iters = inputs.shape[0] // big.ss.take

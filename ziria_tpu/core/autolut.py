@@ -1,0 +1,102 @@
+"""AutoLUT: compile small-domain pure maps into lookup tables.
+
+Counterpart of the reference's AutoLUT pass (SURVEY.md §2.1,
+`AutoLUT.hs`/`LUTAnalysis.hs`/`CgLUT.hs`): it analyzes pure expression
+functions whose inputs have small bit-width and synthesizes compile-time
+lookup tables. TPU-native redesign: the "analysis" is a *declared*
+domain (`zmap(f, in_domain=256)` — the role the reference's `int8`-style
+types play), and "table synthesis" is one vmapped evaluation of `f` over
+``arange(domain)`` at pass time; the rewritten map is a gather
+``table[x]``, which XLA lowers to a fast dynamic-gather (tiny tables
+live comfortably in VMEM and the gather vectorizes across the planner's
+batch axis).
+
+When a LUT map sits next to other maps, the fold pass's map-map fusion
+(core/opt.py) composes the gather with its neighbors, so
+``autolut(fold(p))`` or ``fold(autolut(p))`` both end in fused stages.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ziria_tpu.core import ir
+
+# NOTE: jax is imported inside the functions below so that
+# `import ziria_tpu` (which re-exports `autolut`) stays cheap — the
+# package's core IR layer deliberately avoids jax at import time.
+
+
+class LutError(ValueError):
+    pass
+
+
+MAX_TABLE_ITEMS = 1 << 22  # refuse absurd tables (16 MB of f32)
+
+
+def build_table(m: ir.Map):
+    """Evaluate m.f over its whole declared domain: (domain, *out_item)."""
+    import jax
+    import jax.numpy as jnp
+
+    if m.in_domain is None:
+        raise LutError(f"map {m.label()} has no declared in_domain")
+    if m.in_arity != 1:
+        raise LutError(
+            f"map {m.label()}: AutoLUT needs scalar input items "
+            f"(in_arity == 1); got in_arity={m.in_arity}")
+    dom = int(m.in_domain)
+    if dom <= 0:
+        raise LutError(f"map {m.label()}: in_domain must be positive")
+    if dom > MAX_TABLE_ITEMS:
+        # table.size >= dom always, so refuse before evaluating anything
+        raise LutError(
+            f"map {m.label()}: domain {dom} exceeds the "
+            f"{MAX_TABLE_ITEMS}-item cap; narrow the domain")
+    table = jax.vmap(m.f)(jnp.arange(dom))
+    if table.size > MAX_TABLE_ITEMS:
+        raise LutError(
+            f"map {m.label()}: table of {table.size} items exceeds the "
+            f"{MAX_TABLE_ITEMS}-item cap; narrow the domain")
+    return table
+
+
+def lut_map(m: ir.Map) -> ir.Map:
+    """Rewrite one declared-domain Map into a table gather."""
+    import jax.numpy as jnp
+
+    table = build_table(m)
+
+    def gather(x, _t=table):
+        return _t[jnp.asarray(x, jnp.int32)]
+
+    return ir.Map(gather, in_arity=1, out_arity=m.out_arity,
+                  name=f"lut[{m.label()}]")
+
+
+def autolut(comp: ir.Comp) -> ir.Comp:
+    """Rewrite every Map with a declared in_domain into its LUT form.
+    Structure-preserving everywhere else; semantics identical (tested
+    against the un-LUT'd program on both backends)."""
+    def walk(c: ir.Comp) -> ir.Comp:
+        if isinstance(c, ir.Map) and c.in_domain is not None:
+            return lut_map(c)
+        if isinstance(c, ir.Bind):
+            return ir.Bind(walk(c.first), c.var, walk(c.rest))
+        if isinstance(c, ir.LetRef):
+            return ir.LetRef(c.var, c.init, walk(c.body))
+        if isinstance(c, ir.Repeat):
+            return ir.Repeat(walk(c.body))
+        if isinstance(c, ir.Pipe):
+            return ir.Pipe(walk(c.up), walk(c.down))
+        if isinstance(c, ir.ParPipe):
+            return ir.ParPipe(walk(c.up), walk(c.down))
+        if isinstance(c, ir.For):
+            return ir.For(c.var, c.count, walk(c.body))
+        if isinstance(c, ir.While):
+            return ir.While(c.cond, walk(c.body))
+        if isinstance(c, ir.Branch):
+            return ir.Branch(c.cond, walk(c.then), walk(c.els))
+        return c
+
+    return walk(comp)

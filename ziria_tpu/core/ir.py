@@ -206,12 +206,18 @@ class Map(Comp):
     f takes an array of shape (in_arity, ...) — this is how already-
     vectorized blocks (e.g. a 64-point FFT) appear, and the unit the
     backend's planner multiplies into batch axes.
+
+    `in_domain`, if set, declares that input items are integers in
+    [0, in_domain) — the analogue of the reference's small-bit-width
+    types that drive AutoLUT (core/autolut.py turns such maps into
+    table gathers).
     """
 
     f: Callable[..., Any]
     in_arity: int = 1
     out_arity: int = 1
     name: Optional[str] = None
+    in_domain: Optional[int] = None
 
     def label(self) -> str:
         return self.name or getattr(self.f, "__name__", "Map")
@@ -377,8 +383,8 @@ def assign(var: str, expr: Expr) -> Comp:
 
 
 def zmap(f: Callable, in_arity: int = 1, out_arity: int = 1,
-         name: Optional[str] = None) -> Comp:
-    return Map(f, in_arity, out_arity, name)
+         name: Optional[str] = None, in_domain: Optional[int] = None) -> Comp:
+    return Map(f, in_arity, out_arity, name, in_domain)
 
 
 def map_accum(f: Callable, init: Any, in_arity: int = 1, out_arity: int = 1,

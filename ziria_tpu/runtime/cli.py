@@ -1,0 +1,223 @@
+"""CLI driver: the reference's params.c + driver.c, re-designed.
+
+The reference's compiled executables all share one CLI
+(`csrc/params.c`, SURVEY.md §2.2): ``--input=file --input-file-name=X
+--input-file-mode=dbg|bin --output=...``. This driver keeps that flag
+surface (so reference muscle-memory transfers) and adds the compiler
+flags that in the reference live on `wplc` (`src/Opts.hs`): backend
+selection (``--backend=interp|jit`` — the codegen-backend switch the
+north star pins), vectorization width, ``--fold``/``--autolut``, and
+pass-dump flags.
+
+The program to run is a named pipeline from the registry
+(``--prog=NAME``; `--list-progs` enumerates) — the analogue of picking
+a compiled .blk executable. A textual frontend (.zir source via
+``--src``) plugs in here when the parser lands.
+
+Example:
+
+    python -m ziria_tpu --prog=wifi_tx_sym_6 \
+        --input=file --input-file-name=bits.dbg --input-file-mode=dbg \
+        --input-type=bit \
+        --output=file --output-file-name=out.bin --output-file-mode=bin \
+        --output-type=complex16 --backend=jit
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ziria_tpu.runtime.buffers import ITEM_TYPES, StreamSpec, read_stream, \
+    write_stream
+
+
+# --------------------------------------------------------------------------
+# Program registry
+# --------------------------------------------------------------------------
+
+
+def _prog_fir():
+    """BASELINE config #1: FIR low-pass over a scalar float stream."""
+    import jax.numpy as jnp
+    import ziria_tpu as z
+
+    taps = np.array([0.0625, 0.25, 0.375, 0.25, 0.0625], np.float32)
+
+    def fir_step(state, x):
+        state = jnp.roll(state, 1).at[0].set(x)
+        return state, (state * jnp.asarray(taps)).sum()
+
+    return z.map_accum(fir_step, np.zeros(5, np.float32), name="fir5")
+
+
+def _prog_fft64():
+    """BASELINE config #2: 64-point FFT blocks over complex16 pairs."""
+    import jax.numpy as jnp
+    import ziria_tpu as z
+    from ziria_tpu.ops import cplx
+
+    def fft_block(v):
+        return cplx.fft_pair(jnp.asarray(v, jnp.float32))
+
+    return z.zmap(fft_block, in_arity=64, out_arity=64, name="fft64")
+
+
+def _prog_ifft64():
+    import jax.numpy as jnp
+    import ziria_tpu as z
+    from ziria_tpu.ops import cplx
+
+    def ifft_block(v):
+        return cplx.ifft_pair(jnp.asarray(v, jnp.float32))
+
+    return z.zmap(ifft_block, in_arity=64, out_arity=64, name="ifft64")
+
+
+def _prog_scramble():
+    """802.11 LFSR scrambler over a bit stream (default seed)."""
+    import jax.numpy as jnp
+    import ziria_tpu as z
+    from ziria_tpu.ops import scramble
+    from ziria_tpu.phy.wifi.tx import DEFAULT_SCRAMBLER_SEED, _seed_bits_np
+
+    seq_np = scramble.np_lfsr_sequence_127(
+        _seed_bits_np(DEFAULT_SCRAMBLER_SEED))
+
+    def step(phase, b):
+        out = jnp.asarray(b, jnp.uint8) ^ jnp.asarray(seq_np)[phase % 127]
+        return phase + 1, out
+
+    return z.map_accum(step, 0, name="scramble")
+
+
+def _wifi_tx_sym(rate_mbps: int):
+    def build():
+        from ziria_tpu.phy.wifi.tx import tx_symbol_pipeline
+        return tx_symbol_pipeline(rate_mbps)
+    return build
+
+
+PROGS: Dict[str, Callable] = {
+    "fir": _prog_fir,
+    "fft64": _prog_fft64,
+    "ifft64": _prog_ifft64,
+    "scramble": _prog_scramble,
+}
+for _r in (6, 9, 12, 18, 24, 36, 48, 54):
+    PROGS[f"wifi_tx_sym_{_r}"] = _wifi_tx_sym(_r)
+
+
+# --------------------------------------------------------------------------
+# Arg parsing (reference params.c flag names)
+# --------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ziria_tpu",
+        description="TPU-native stream pipeline driver "
+                    "(reference-style params)")
+    p.add_argument("--prog", help="registered pipeline name")
+    p.add_argument("--src", help="Ziria-like source file (.zir) to compile")
+    p.add_argument("--list-progs", action="store_true")
+
+    # `memory` streams are the programmatic API (StreamSpec(data=...));
+    # argv has no way to carry an array, so the CLI offers file|dummy only
+    p.add_argument("--input", default="file", choices=["file", "dummy"])
+    p.add_argument("--input-file-name")
+    p.add_argument("--input-file-mode", default="dbg",
+                   choices=["dbg", "bin"])
+    p.add_argument("--input-type", default="int32", choices=ITEM_TYPES)
+    p.add_argument("--dummy-samples", type=int, default=0)
+
+    p.add_argument("--output", default="file", choices=["file", "dummy"])
+    p.add_argument("--output-file-name")
+    p.add_argument("--output-file-mode", default="dbg",
+                   choices=["dbg", "bin"])
+    p.add_argument("--output-type", default="int32", choices=ITEM_TYPES)
+
+    p.add_argument("--backend", default="jit", choices=["interp", "jit"])
+    p.add_argument("--width", type=int, default=None,
+                   help="vectorization width (default: planner)")
+    p.add_argument("--fold", action="store_true", default=True)
+    p.add_argument("--no-fold", dest="fold", action="store_false")
+    p.add_argument("--autolut", action="store_true")
+    p.add_argument("--ddump-fold", action="store_true",
+                   help="dump the IR after folding")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def _resolve_prog(args):
+    if args.src:
+        try:
+            from ziria_tpu.frontend import compile_file
+        except ImportError:
+            raise SystemExit(
+                "--src: the textual frontend is not available in this "
+                "build; use --prog=NAME (--list-progs to enumerate)")
+        return compile_file(args.src)
+    if not args.prog:
+        raise SystemExit("need --prog=NAME or --src=FILE "
+                         "(--list-progs to enumerate)")
+    if args.prog not in PROGS:
+        raise SystemExit(
+            f"unknown prog {args.prog!r}; known: {', '.join(sorted(PROGS))}")
+    return PROGS[args.prog]()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_progs:
+        for name in sorted(PROGS):
+            print(name)
+        return 0
+
+    comp = _resolve_prog(args)
+
+    # autolut first: fold's map-map fusion erases in_domain declarations,
+    # so the LUT rewrite must see the maps before they fuse
+    if args.autolut:
+        from ziria_tpu.core.autolut import autolut
+        comp = autolut(comp)
+    if args.fold:
+        from ziria_tpu.core.opt import fold
+        comp = fold(comp)
+    if args.ddump_fold:
+        print(comp, file=sys.stderr)
+
+    in_spec = StreamSpec(kind=args.input, ty=args.input_type,
+                         path=args.input_file_name,
+                         mode=args.input_file_mode,
+                         dummy_items=args.dummy_samples)
+    out_spec = StreamSpec(kind=args.output, ty=args.output_type,
+                          path=args.output_file_name,
+                          mode=args.output_file_mode)
+
+    xs = read_stream(in_spec)
+    t0 = time.perf_counter()
+    if args.backend == "interp":
+        from ziria_tpu.interp.interp import run
+        res = run(comp, list(xs))
+        ys = np.asarray(res.out_array())
+    else:
+        from ziria_tpu.backend.execute import run_jit
+        ys = np.asarray(run_jit(comp, xs, width=args.width))
+    dt = time.perf_counter() - t0
+
+    write_stream(out_spec, ys)
+    if args.verbose:
+        print(f"items in: {xs.shape[0]}, items out: {ys.shape[0]}, "
+              f"time: {dt:.4f}s "
+              f"({xs.shape[0] / max(dt, 1e-12):,.0f} items/s)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
