@@ -77,3 +77,16 @@ def test_nested_structure_rewritten():
     lutted = autolut(prog)
     assert isinstance(lutted, ir.Pipe)
     assert lutted.down.label().startswith("lut[")
+
+
+def test_fusion_preserves_in_domain():
+    """Map-map fusion keeps the upstream's declared domain, so
+    autolut(fold(p)) still applies the LUT rewrite (the documented
+    order is autolut-then-fold, but the other order must not silently
+    lose the declaration)."""
+    from ziria_tpu.core.opt import fold
+    prog = z.pipe(z.zmap(popcount8, in_domain=256, name="pc"),
+                  z.zmap(lambda x: x + 1, name="inc"))
+    fused = fold(prog)
+    assert isinstance(fused, ir.Map) and fused.in_domain == 256
+    assert autolut(fused).label().startswith("lut[")

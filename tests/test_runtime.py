@@ -145,5 +145,7 @@ def test_package_import_stays_jax_free():
     # "importing ziria_tpu adds no jax", not "jax is absent"
     code = ("import sys; pre = 'jax' in sys.modules; import ziria_tpu; "
             "sys.exit(1 if ('jax' in sys.modules and not pre) else 0)")
-    r = subprocess.run([sys.executable, "-c", code], cwd="/root/repo")
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", code], cwd=repo)
     assert r.returncode == 0

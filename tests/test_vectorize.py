@@ -1,0 +1,210 @@
+"""Vectorizer tests: scale-factor search, widening rewrite, mitigators,
+mixed static/dynamic execution.
+
+The reference's vectorizer invariant (SURVEY.md §4): output is identical
+with and without vectorization, for every width choice. The matrix here
+is {interpreter oracle} x {widen(w) for several w} x {per-stage widths
+with mitigators} x {run_vect planned execution}.
+"""
+
+import numpy as np
+import pytest
+
+import ziria_tpu as z
+from ziria_tpu.backend.execute import run_jit, run_vect
+from ziria_tpu.core import ir
+from ziria_tpu.core.card import steady_state
+from ziria_tpu.core.vectorize import (
+    mitigator,
+    search_width,
+    utility,
+    vectorize,
+    widen,
+)
+from ziria_tpu.interp.interp import run
+from ziria_tpu.utils.diff import assert_stream_eq
+
+
+def _fir_prog():
+    import jax.numpy as jnp
+    taps = np.array([0.25, 0.5, 0.25], dtype=np.float32)
+
+    def fir_step(state, x):
+        state = jnp.roll(state, 1).at[0].set(x)
+        return state, (state * taps).sum()
+
+    return z.pipe(z.zmap(lambda x: x * 2.0),
+                  z.map_accum(fir_step, np.zeros(3, np.float32)),
+                  z.zmap(lambda x: x + 1.0))
+
+
+def _rate_change_prog():
+    """3->1 then 1->2: steady state reps (1, 1, 3) on a 3-in chain."""
+    import jax.numpy as jnp
+    return z.pipe(
+        z.zmap(lambda v: v.sum(), in_arity=3, out_arity=1, name="sum3"),
+        z.zmap(lambda x: jnp.stack([x, -x]), in_arity=1, out_arity=2,
+               name="split2"),
+    )
+
+
+# ----------------------------------------------------------------- planning
+
+
+def test_search_width_prefers_amortization():
+    prog = z.pipe(z.zmap(lambda x: x + 1), z.zmap(lambda x: x * 2))
+    ss = steady_state(ir.pipeline_stages(prog))
+    W, cands = search_width(ss, ir.pipeline_stages(prog))
+    # stateless chain: width should grow well past 1 to amortize the
+    # per-step overhead
+    assert W >= 256
+    assert all(c[1] != float("-inf") or c[0] == cands[-1][0] for c in cands)
+
+
+def test_search_width_respects_vmem_budget():
+    prog = z.pipe(z.zmap(lambda x: x + 1), z.zmap(lambda x: x * 2))
+    ss = steady_state(ir.pipeline_stages(prog))
+    budget = 1 << 12  # 4 KiB
+    item_bytes = 4
+    W, cands = search_width(ss, ir.pipeline_stages(prog),
+                            item_bytes=item_bytes, vmem_budget=budget)
+    assert W * ss.take * item_bytes <= budget
+    # the search stopped at the first infeasible candidate
+    assert cands[-1][1] == float("-inf")
+
+
+def test_utility_stateful_narrower_than_stateless():
+    """A scan-dominated segment should pick a narrower width than a pure
+    vmap segment: sequential firings stop paying once overhead is
+    amortized."""
+    stateless = z.pipe(z.zmap(lambda x: x + 1), z.zmap(lambda x: x * 2))
+    stateful = _fir_prog()
+    ss_l = steady_state(ir.pipeline_stages(stateless))
+    ss_f = steady_state(ir.pipeline_stages(stateful))
+    W_l, _ = search_width(ss_l, ir.pipeline_stages(stateless))
+    W_f, _ = search_width(ss_f, ir.pipeline_stages(stateful))
+    assert W_f <= W_l
+
+
+def test_vectorize_dump_lists_candidates():
+    plan = vectorize(_fir_prog())
+    text = plan.dump()
+    assert "width" in text and "utility=" in text and "W=1" in text
+    assert len(plan.segments) == 1
+    seg = plan.segments[0]
+    assert not seg.dynamic
+    assert any(W == seg.width for W, _, _ in seg.candidates)
+
+
+def test_vectorize_splits_at_dynamic_stage():
+    dyn = ir.Repeat(z.seq(z.let("x", z.take,
+                                z.while_loop(lambda env: False,
+                                             z.ret(0))),
+                          z.emit(lambda env: env["x"])))
+    prog = z.pipe(z.zmap(lambda x: x + 1), dyn, z.zmap(lambda x: x * 2))
+    plan = vectorize(prog)
+    kinds = [seg.dynamic for seg in plan.segments]
+    assert kinds == [False, True, False]
+    assert "DYNAMIC" in plan.dump()
+
+
+# ----------------------------------------------------------------- widening
+
+
+@pytest.mark.parametrize("w", [1, 2, 4, 8])
+def test_widen_invariance_uniform(w):
+    prog = _fir_prog()
+    xs = np.arange(64, dtype=np.float32)
+    want = run(prog, list(xs)).out_array()
+
+    wide = widen(prog, w)
+    blocks = xs if w == 1 else xs.reshape(-1, w)
+    got_i = np.asarray(run(wide, list(blocks)).out_array()).reshape(-1)
+    assert_stream_eq(got_i, want, atol=1e-6, rtol=1e-6, name=f"interp w={w}")
+
+    got_j = np.asarray(run_jit(wide, blocks)).reshape(-1)
+    assert_stream_eq(got_j, want, atol=1e-6, rtol=1e-6, name=f"jit w={w}")
+
+
+@pytest.mark.parametrize("w", [2, 4])
+def test_widen_rate_change_stage(w):
+    """Widening a stage with in_arity/out_arity > 1 keeps raw stream
+    order (the take->takes reshape algebra)."""
+    prog = _rate_change_prog()
+    xs = np.arange(48, dtype=np.float32)
+    want = run(prog, list(xs)).out_array()
+    wide = widen(prog, w)
+    blocks = xs.reshape(-1, w)
+    got = np.asarray(run_jit(wide, blocks)).reshape(-1)
+    assert_stream_eq(got, want, name=f"rate-change w={w}")
+
+
+def test_widen_per_stage_inserts_mitigator():
+    prog = z.pipe(z.zmap(lambda x: x + 1, name="a"),
+                  z.zmap(lambda x: x * 2, name="b"))
+    wide = widen(prog, {0: 4, 1: 2})
+    labels = [s.label() for s in ir.pipeline_stages(wide)]
+    assert any("mitigate[4->2]" in l for l in labels)
+
+    xs = np.arange(32, dtype=np.float32)
+    want = run(prog, list(xs)).out_array()
+    got = np.asarray(run_jit(wide, xs.reshape(-1, 4))).reshape(-1)
+    assert_stream_eq(got, want, name="mitigated")
+
+
+def test_mitigator_is_stream_identity():
+    m = mitigator(6, 4)
+    xs = np.arange(24, dtype=np.int32).reshape(-1, 6)
+    out = np.asarray(run_jit(m, xs))
+    assert out.shape == (6, 4)
+    np.testing.assert_array_equal(out.reshape(-1), np.arange(24))
+
+
+def test_widen_repeat_stage():
+    body = z.seq(z.let("x", z.take, z.emit(lambda env: env["x"] + 10.0)))
+    prog = z.pipe(z.repeat(body), z.zmap(lambda x: x * 0.5))
+    xs = np.arange(16, dtype=np.float32)
+    want = run(prog, list(xs)).out_array()
+    got = np.asarray(run_jit(widen(prog, 4), xs.reshape(-1, 4))).reshape(-1)
+    assert_stream_eq(got, want, name="widened repeat")
+
+
+# ----------------------------------------------------------- mixed execution
+
+
+def test_run_vect_fully_static_matches_oracle():
+    prog = _fir_prog()
+    xs = np.arange(256, dtype=np.float32)
+    want = run(prog, list(xs)).out_array()
+    got = run_vect(prog, xs)
+    assert_stream_eq(np.asarray(got), want, atol=1e-6, rtol=1e-6,
+                     name="run_vect static")
+
+
+def test_run_vect_bridges_dynamic_segment():
+    # middle stage: data-dependent while loop (emit x, but first loop
+    # x times decrementing a ref) — interpreter-only
+    def body():
+        return z.seq(
+            z.let("x", z.take,
+                  z.let_ref("n", lambda env: int(env["x"]) % 3,
+                            z.seq(z.while_loop(
+                                lambda env: env["n"] > 0,
+                                z.assign("n", lambda env: env["n"] - 1)),
+                                z.emit(lambda env: env["x"])))))
+
+    dyn = ir.Repeat(body())
+    prog = z.pipe(z.zmap(lambda x: x + 1), dyn,
+                  z.zmap(lambda x: x * 2))
+    xs = np.arange(32, dtype=np.int64)
+    want = run(prog, list(xs)).out_array()
+    got = run_vect(prog, xs)
+    assert_stream_eq(np.asarray(got), want, name="run_vect mixed")
+
+
+def test_run_vect_rate_change_pipeline():
+    prog = _rate_change_prog()
+    xs = np.arange(96, dtype=np.float32)
+    want = run(prog, list(xs)).out_array()
+    got = run_vect(prog, xs)
+    assert_stream_eq(np.asarray(got), want, name="run_vect rates")

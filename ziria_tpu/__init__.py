@@ -60,3 +60,9 @@ from ziria_tpu.core.types import (  # noqa: F401
 )
 from ziria_tpu.core.opt import fold, fold_with_stats  # noqa: F401
 from ziria_tpu.core.autolut import autolut  # noqa: F401
+from ziria_tpu.core.vectorize import (  # noqa: F401
+    VectPlan,
+    mitigator,
+    vectorize,
+    widen,
+)

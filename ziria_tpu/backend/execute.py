@@ -81,3 +81,34 @@ def run_jit(comp: ir.Comp, inputs, width: Optional[int] = None,
         # with the input's item shape as the best available annotation
         return np.empty((0,) + inputs.shape[1:])
     return np.concatenate(outs, axis=0)
+
+
+def run_vect(comp: ir.Comp, inputs, plan=None, optimize: bool = False,
+             item_bytes: int = 4) -> np.ndarray:
+    """Run a pipeline under the vectorizer's plan (core/vectorize.py).
+
+    Static segments run fused under jit at their searched widths;
+    dynamic segments (no static cardinality) run on the interpreter —
+    the host boundary between segments is the mitigator. A fully static
+    pipeline degenerates to ``run_jit`` at the planned width; a fully
+    dynamic one to the interpreter. This is the executable form of the
+    reference's "vectorize what you can, skip what you can't"
+    (SURVEY.md §2.1 Vectorize).
+    """
+    from ziria_tpu.core.vectorize import vectorize
+    from ziria_tpu.interp import interp
+
+    if optimize:
+        from ziria_tpu.core.opt import fold
+        comp = fold(comp)
+    if plan is None:
+        plan = vectorize(comp, item_bytes=item_bytes)
+    stream = np.asarray(inputs)
+    for seg in plan.segments:
+        if seg.dynamic:
+            stream = interp.run(seg.comp, stream).out_array()
+        else:
+            stream = run_jit(seg.comp, stream, width=seg.width)
+        if stream.shape[0] == 0:
+            return stream
+    return stream

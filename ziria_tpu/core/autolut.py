@@ -81,22 +81,6 @@ def autolut(comp: ir.Comp) -> ir.Comp:
     def walk(c: ir.Comp) -> ir.Comp:
         if isinstance(c, ir.Map) and c.in_domain is not None:
             return lut_map(c)
-        if isinstance(c, ir.Bind):
-            return ir.Bind(walk(c.first), c.var, walk(c.rest))
-        if isinstance(c, ir.LetRef):
-            return ir.LetRef(c.var, c.init, walk(c.body))
-        if isinstance(c, ir.Repeat):
-            return ir.Repeat(walk(c.body))
-        if isinstance(c, ir.Pipe):
-            return ir.Pipe(walk(c.up), walk(c.down))
-        if isinstance(c, ir.ParPipe):
-            return ir.ParPipe(walk(c.up), walk(c.down))
-        if isinstance(c, ir.For):
-            return ir.For(c.var, c.count, walk(c.body))
-        if isinstance(c, ir.While):
-            return ir.While(c.cond, walk(c.body))
-        if isinstance(c, ir.Branch):
-            return ir.Branch(c.cond, walk(c.then), walk(c.els))
-        return c
+        return ir.map_children(c, lambda ch, _binds: walk(ch))
 
     return walk(comp)

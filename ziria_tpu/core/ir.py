@@ -436,6 +436,44 @@ def jax_block(f: Callable, init: Any = None, in_arity: int = 1,
 # --------------------------------------------------------------------------
 
 
+def map_children(c: Comp, f: Callable[[Comp, bool], Comp]) -> Comp:
+    """Rebuild `c` with `f` applied to each direct child computation.
+
+    `f(child, binds)` — `binds` is True when the construct introduces a
+    binding visible inside that child (Bind's rest under a named var,
+    LetRef's body, For's body under a loop var). Returns `c` itself when
+    no child changed, so rewrite passes can detect fixpoints by
+    identity. The single structural walker shared by the fold pass and
+    AutoLUT — add new container nodes HERE, once.
+    """
+    if isinstance(c, Bind):
+        a = f(c.first, False)
+        b = f(c.rest, c.var is not None)
+        return c if a is c.first and b is c.rest else Bind(a, c.var, b)
+    if isinstance(c, LetRef):
+        b = f(c.body, True)
+        return c if b is c.body else LetRef(c.var, c.init, b)
+    if isinstance(c, Repeat):
+        b = f(c.body, False)
+        return c if b is c.body else Repeat(b)
+    if isinstance(c, Pipe):
+        a, b = f(c.up, False), f(c.down, False)
+        return c if a is c.up and b is c.down else Pipe(a, b)
+    if isinstance(c, ParPipe):
+        a, b = f(c.up, False), f(c.down, False)
+        return c if a is c.up and b is c.down else ParPipe(a, b)
+    if isinstance(c, For):
+        b = f(c.body, c.var is not None)
+        return c if b is c.body else For(c.var, c.count, b)
+    if isinstance(c, While):
+        b = f(c.body, False)
+        return c if b is c.body else While(c.cond, b)
+    if isinstance(c, Branch):
+        a, b = f(c.then, False), f(c.els, False)
+        return c if a is c.then and b is c.els else Branch(c.cond, a, b)
+    return c
+
+
 def pipeline_stages(comp: Comp) -> Sequence[Comp]:
     """Flatten nested Pipe into a left-to-right stage list (Pipe only —
     ParPipe boundaries are preserved as units; see parallel/stages.py)."""

@@ -107,9 +107,12 @@ def _map_fusions(c: ir.Comp) -> Optional[ir.Comp]:
     up, down = c.up, c.down
     if (isinstance(up, ir.Map) and isinstance(down, ir.Map)
             and up.out_arity == down.in_arity):
+        # the fused map's input domain IS the upstream's declared domain,
+        # so AutoLUT still applies after fusion
         return ir.Map(_compose_maps(up.f, down.f), up.in_arity,
                       down.out_arity,
-                      name=f"{down.label()}.{up.label()}")
+                      name=f"{down.label()}.{up.label()}",
+                      in_domain=up.in_domain)
     if (isinstance(up, ir.Map) and isinstance(down, ir.MapAccum)
             and up.out_arity == down.in_arity):
         def fa(s, x, _f=up.f, _g=down.f):
@@ -168,35 +171,10 @@ def _rewrite_node(c: ir.Comp, rules) -> Tuple[ir.Comp, int]:
 
 def _rebuild(c: ir.Comp, f: Callable[[ir.Comp, bool], ir.Comp],
              scoped: bool) -> ir.Comp:
-    """Apply f to each child, rebuilding only when something changed.
-    `scoped` is True once any enclosing construct introduced a binding
-    visible to descendants."""
-    if isinstance(c, ir.Bind):
-        a = f(c.first, scoped)
-        b = f(c.rest, scoped or c.var is not None)
-        return c if a is c.first and b is c.rest else ir.Bind(a, c.var, b)
-    if isinstance(c, ir.LetRef):
-        b = f(c.body, True)
-        return c if b is c.body else ir.LetRef(c.var, c.init, b)
-    if isinstance(c, ir.Repeat):
-        b = f(c.body, scoped)
-        return c if b is c.body else ir.Repeat(b)
-    if isinstance(c, ir.Pipe):
-        a, b = f(c.up, scoped), f(c.down, scoped)
-        return c if a is c.up and b is c.down else ir.Pipe(a, b)
-    if isinstance(c, ir.ParPipe):
-        a, b = f(c.up, scoped), f(c.down, scoped)
-        return c if a is c.up and b is c.down else ir.ParPipe(a, b)
-    if isinstance(c, ir.For):
-        b = f(c.body, scoped or c.var is not None)
-        return c if b is c.body else ir.For(c.var, c.count, b)
-    if isinstance(c, ir.While):
-        b = f(c.body, scoped)
-        return c if b is c.body else ir.While(c.cond, b)
-    if isinstance(c, ir.Branch):
-        a, b = f(c.then, scoped), f(c.els, scoped)
-        return c if a is c.then and b is c.els else ir.Branch(c.cond, a, b)
-    return c
+    """Apply f to each child via the shared walker (ir.map_children),
+    threading `scoped` — True once any enclosing construct introduced a
+    binding visible to descendants."""
+    return ir.map_children(c, lambda ch, binds: f(ch, scoped or binds))
 
 
 @dataclass

@@ -24,8 +24,6 @@ Item types:
 
 from __future__ import annotations
 
-import io
-import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -82,9 +80,12 @@ def _format_dbg(arr: np.ndarray, ty: str) -> str:
     if ty == "bit":
         return "".join("1" if v else "0" for v in arr.ravel())
     flat = arr.ravel()
-    if np.issubdtype(flat.dtype, np.floating):
-        return ",".join(f"{float(v):g}" for v in flat)
-    return ",".join(str(int(v)) for v in flat)
+    if ty in ("float32", "float64"):
+        # repr-faithful digits so dbg text round-trips exactly
+        prec = ".9g" if flat.dtype == np.float32 else ".17g"
+        return ",".join(f"{float(v):{prec}}" for v in flat)
+    # integer item type: round float pipeline outputs, don't truncate
+    return ",".join(str(int(round(float(v)))) for v in flat)
 
 
 # --------------------------------------------------------------------------
@@ -109,7 +110,11 @@ def _format_bin(arr: np.ndarray, ty: str) -> bytes:
         bits = np.asarray(arr, np.uint8).ravel()
         return np.packbits(bits, bitorder="little").tobytes()
     base = _SCALAR_DTYPES.get(ty) or _PAIR_DTYPES[ty]
-    return np.asarray(arr, base).astype(
+    a = np.asarray(arr)
+    if (np.issubdtype(a.dtype, np.floating)
+            and np.issubdtype(np.dtype(base), np.integer)):
+        a = np.rint(a)  # round float pipeline outputs, don't truncate
+    return np.asarray(a, base).astype(
         np.dtype(base).newbyteorder("<")).tobytes()
 
 
