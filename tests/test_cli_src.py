@@ -1,0 +1,106 @@
+"""CLI end-to-end over .zir sources: the reference's golden-file flow.
+
+Each examples/*.zir compiles via --src and runs through the driver with
+file I/O in both dbg and bin modes, on both backends; outputs must agree
+with the interpreter oracle (the reference's BlinkDiff discipline,
+SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ziria_tpu.frontend import compile_file
+from ziria_tpu.interp.interp import run
+from ziria_tpu.runtime.buffers import StreamSpec, read_stream, write_stream
+from ziria_tpu.runtime.cli import main as cli_main
+from ziria_tpu.utils.diff import stream_diff
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run_cli(src, in_arr, in_ty, tmp_path, mode="dbg", backend="jit",
+             extra=()):
+    inf = tmp_path / f"in.{mode}"
+    outf = tmp_path / f"out.{mode}"
+    write_stream(StreamSpec(ty=in_ty, path=str(inf), mode=mode), in_arr)
+    rc = cli_main([
+        f"--src={src}",
+        "--input=file", f"--input-file-name={inf}",
+        f"--input-file-mode={mode}",
+        "--output=file", f"--output-file-name={outf}",
+        f"--output-file-mode={mode}", f"--backend={backend}", *extra,
+    ])
+    assert rc == 0
+    prog = compile_file(str(src))
+    return read_stream(StreamSpec(ty=prog.out_ty or in_ty, path=str(outf),
+                                  mode=mode))
+
+
+def _oracle(src, in_arr):
+    prog = compile_file(str(src))
+    return run(prog.comp, list(np.asarray(in_arr))).out_array()
+
+
+@pytest.mark.parametrize("mode", ["dbg", "bin"])
+@pytest.mark.parametrize("backend", ["interp", "jit"])
+def test_scrambler_cli(tmp_path, mode, backend):
+    src = os.path.join(EXAMPLES, "scrambler.zir")
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 2, 256).astype(np.uint8)
+    out = _run_cli(src, xs, "bit", tmp_path, mode, backend)
+    want = _oracle(src, xs)
+    np.testing.assert_array_equal(out, want.astype(np.uint8))
+    # known-answer: scrambling zeros yields the 127-bit sequence
+    from ziria_tpu.ops.scramble import np_lfsr_sequence_127
+    zs = np.zeros(127, np.uint8)
+    out0 = _run_cli(src, zs, "bit", tmp_path, mode, backend)
+    # bin mode pads bit streams to a byte boundary (no length header,
+    # same as the reference's buf_bit) — compare the first 127
+    np.testing.assert_array_equal(
+        out0[:127], np_lfsr_sequence_127(
+            np.array([1, 0, 1, 1, 1, 0, 1], np.uint8)))
+
+
+@pytest.mark.parametrize("backend", ["interp", "jit"])
+def test_fir_cli(tmp_path, backend):
+    src = os.path.join(EXAMPLES, "fir.zir")
+    xs = (100 * np.sin(np.arange(200) / 5)).astype(np.int32)
+    out = _run_cli(src, xs, "int32", tmp_path, "dbg", backend)
+    want = _oracle(src, xs)
+    np.testing.assert_array_equal(out, want)
+
+
+@pytest.mark.parametrize("mode", ["dbg", "bin"])
+def test_fft64_cli(tmp_path, mode):
+    src = os.path.join(EXAMPLES, "fft64.zir")
+    rng = np.random.default_rng(2)
+    xs = rng.integers(-512, 512, (256, 2)).astype(np.int16)
+    out = _run_cli(src, xs, "complex16", tmp_path, mode)
+    want = _oracle(src, xs)
+    # int16 quantization on the way out: tolerance compare (BlinkDiff role)
+    rep = stream_diff(out.astype(np.float64), want.astype(np.float64),
+                      atol=1.0)
+    assert rep, rep.message
+
+
+def test_interleaver_cli_flag_matrix(tmp_path):
+    """Flag matrix: fold/autolut/backends must not change output."""
+    src = os.path.join(EXAMPLES, "interleaver.zir")
+    rng = np.random.default_rng(3)
+    xs = rng.integers(0, 2, 480).astype(np.uint8)
+    want = _oracle(src, xs)
+    for backend in ("interp", "jit"):
+        for extra in ((), ("--no-fold",), ("--autolut",)):
+            out = _run_cli(src, xs, "bit", tmp_path, "dbg", backend,
+                           extra=extra)
+            np.testing.assert_array_equal(out, want.astype(np.uint8),
+                                          err_msg=f"{backend} {extra}")
+    # and the permutation is its own inverse's inverse: applying it twice
+    # on indices returns sorted order only for the identity — sanity-check
+    # the known BPSK pattern instead
+    blk = want[:48]
+    k = np.arange(48)
+    perm = 3 * (k % 16) + k // 16
+    src_blk = xs[:48]
+    np.testing.assert_array_equal(blk[perm], src_blk)

@@ -1,0 +1,417 @@
+"""End-to-end frontend tests: parse → elaborate → run on BOTH backends.
+
+The flag-matrix discipline of the reference test suite (SURVEY.md §4):
+every program must produce identical output under the interpreter oracle
+and the fused jit backend, with and without the fold pass.
+"""
+
+import numpy as np
+import pytest
+
+import ziria_tpu as z
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.core import ir
+from ziria_tpu.core.localize import localize
+from ziria_tpu.core.opt import fold
+from ziria_tpu.core.types import typecheck
+from ziria_tpu.frontend import ElabError, ZiriaRuntimeError, compile_source
+from ziria_tpu.interp.interp import run
+
+
+def both_backends(prog, xs, max_out=None):
+    """Run under interp and jit (fold on/off); assert all agree."""
+    res = run(prog.comp, list(np.asarray(xs)), max_out=max_out)
+    ref = res.out_array()
+    outs = {"interp": ref}
+    outs["jit"] = run_jit(prog.comp, xs)
+    outs["jit+fold"] = run_jit(prog.comp, xs, optimize=True)
+    for name, got in outs.items():
+        got = np.asarray(got)
+        assert got.shape[0] == ref.shape[0], \
+            f"{name}: {got.shape} vs interp {ref.shape}"
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), np.asarray(ref, np.float64),
+            rtol=1e-5, atol=1e-5, err_msg=name)
+    return ref
+
+
+# ------------------------------------------------------------------ basics
+
+def test_map_fun_pipeline():
+    prog = compile_source("""
+      fun incr(x: int32) : int32 { return x + 1 }
+      let comp main = read[int32] >>> map incr >>> write[int32]
+    """)
+    assert prog.in_ty == "int32" and prog.out_ty == "int32"
+    xs = np.arange(32, dtype=np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, xs + 1)
+
+
+def test_repeat_take_emit_expression():
+    prog = compile_source("""
+      let comp main = read[int32] >>> repeat { x <- take; emit x * x }
+                      >>> write[int32]
+    """)
+    xs = np.arange(16, dtype=np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, xs * xs)
+
+
+def test_takes_emits_block():
+    prog = compile_source("""
+      let comp main = read[int32] >>>
+        repeat { (x: arr[4] int32) <- takes 4; emits x[0,2]; emit x[3] }
+        >>> write[int32]
+    """)
+    xs = np.arange(16, dtype=np.int32)
+    out = both_backends(prog, xs)
+    want = np.concatenate([[4 * k, 4 * k + 1, 4 * k + 3]
+                           for k in range(4)])
+    np.testing.assert_array_equal(out, want)
+
+
+def test_stateful_scrambler_localizes_to_mapaccum():
+    """The ASPLOS scrambler shape: var + repeat + do-block → MapAccum."""
+    prog = compile_source("""
+      let comp scrambler = {
+        var st : arr[7] bit := {'1,'1,'1,'1,'1,'1,'1};
+        var tmp : bit := '0;
+        repeat {
+          x <- take;
+          do { tmp := st[3] ^ st[6];
+               st[1, 6] := st[0, 6];
+               st[0] := tmp };
+          emit x ^ tmp
+        }
+      }
+      let comp main = read[bit] >>> scrambler >>> write[bit]
+    """)
+    # localization must have produced a MapAccum (jit-able state)
+    assert isinstance(prog.comp, ir.MapAccum), type(prog.comp).__name__
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 2, 128).astype(np.uint8)
+    out = both_backends(prog, xs)
+    # oracle: the same LFSR in numpy (x^{7}+x^{4}+1, MSB-first shift-down)
+    st = np.ones(7, np.uint8)
+    want = np.zeros(128, np.uint8)
+    for k, x in enumerate(xs):
+        tmp = st[3] ^ st[6]
+        st[1:7] = st[0:6]
+        st[0] = tmp
+        want[k] = x ^ tmp
+    np.testing.assert_array_equal(out.astype(np.uint8), want)
+
+
+def test_wifi_scrambler_matches_ops_oracle():
+    """802.11 scrambler written in surface syntax == ops/scramble.py."""
+    from ziria_tpu.ops.scramble import np_lfsr_sequence_127
+    prog = compile_source("""
+      let comp main = read[bit] >>> {
+        var st : arr[7] bit := {'1,'0,'1,'1,'1,'0,'1};
+        repeat {
+          x <- take;
+          var fb : bit := '0;
+          do { fb := st[3] ^ st[0];
+               st[0, 6] := st[1, 6];
+               st[6] := fb };
+          emit x ^ fb
+        }
+      } >>> write[bit]
+    """)
+    seed = np.array([1, 0, 1, 1, 1, 0, 1], np.uint8)
+    seq = np_lfsr_sequence_127(seed)
+    xs = np.zeros(254, np.uint8)   # scrambling zeros yields the sequence
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out.astype(np.uint8),
+                                  np.resize(seq, 254))
+
+
+def test_fir_with_state():
+    prog = compile_source("""
+      let comp main = read[int32] >>> {
+        var delay : arr[4] int32 := {0, 0, 0, 0};
+        repeat {
+          x <- take;
+          do { delay[1, 3] := delay[0, 3]; delay[0] := x };
+          emit delay[0] + delay[1] + delay[2] + delay[3]
+        }
+      } >>> write[int32]
+    """)
+    xs = np.arange(1, 33, dtype=np.int32)
+    out = both_backends(prog, xs)
+    want = np.convolve(xs, np.ones(4, np.int64))[:32].astype(np.int64)
+    np.testing.assert_array_equal(out.astype(np.int64), want)
+
+
+# ------------------------------------------------------------ control flow
+
+def test_static_for_loop_unrolled():
+    prog = compile_source("""
+      let comp main = read[int32] >>>
+        repeat { x <- take; for i in [1, 3] { emit x * i } }
+        >>> write[int32]
+    """)
+    xs = np.array([10, 20], np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, [10, 20, 30, 20, 40, 60])
+
+
+def test_dynamic_if_in_do_block_stages():
+    """Data-dependent statement-if must stage into where() under jit."""
+    prog = compile_source("""
+      let comp main = read[int32] >>> {
+        var acc : int32 := 0;
+        repeat {
+          x <- take;
+          do { if x > 0 then { acc := acc + x } else { acc := acc - 1 } };
+          emit acc
+        }
+      } >>> write[int32]
+    """)
+    xs = np.array([5, -2, 3, 0, 7], np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, [5, 4, 7, 6, 13])
+
+
+def test_expression_cond_dynamic():
+    prog = compile_source("""
+      let comp main = read[int32] >>>
+        repeat { x <- take; emit (if x % 2 == 0 then x / 2 else 3 * x + 1) }
+        >>> write[int32]
+    """)
+    xs = np.array([6, 7, 8, 9], np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, [3, 22, 4, 28])
+
+
+def test_comp_if_static_folds():
+    prog = compile_source("""
+      let rate = 2
+      fun dbl(x: int32) : int32 { return 2 * x }
+      fun neg(x: int32) : int32 { return -x }
+      let comp main = read[int32] >>>
+        (if rate > 1 then map dbl else map neg) >>> write[int32]
+    """)
+    xs = np.arange(8, dtype=np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, 2 * xs)
+
+
+def test_while_computer_interp():
+    """Dynamic while runs on the interpreter (jit refuses, by design)."""
+    prog = compile_source("""
+      let comp main = read[int32] >>> {
+        var n : int32 := 3;
+        while (n > 0) { x <- take; do { n := n - 1 }; emit x * 10 }
+      } >>> write[int32]
+    """)
+    res = run(prog.comp, list(np.arange(8, dtype=np.int32)))
+    np.testing.assert_array_equal(res.out_array(), [0, 10, 20])
+
+
+def test_until_loop_interp():
+    prog = compile_source("""
+      let comp main = read[int32] >>> {
+        var s : int32 := 0;
+        until (s >= 10) { x <- take; do { s := s + x }; emit s }
+      } >>> write[int32]
+    """)
+    res = run(prog.comp, list(np.arange(1, 9, dtype=np.int32)))
+    np.testing.assert_array_equal(res.out_array(), [1, 3, 6, 10])
+
+
+# ------------------------------------------------------------- comp funs
+
+def test_comp_fun_static_arg_inlines():
+    prog = compile_source("""
+      fun comp scale(k: int32) { repeat { x <- take; emit x * k } }
+      let comp main = read[int32] >>> scale(3) >>> scale(2)
+                      >>> write[int32]
+    """)
+    xs = np.arange(8, dtype=np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, 6 * xs)
+
+
+def test_comp_fun_runtime_arg():
+    """A comp-fun arg depending on a bound value threads via the env."""
+    prog = compile_source("""
+      fun comp add_k(k: int32) { repeat { x <- take; emit x + k } }
+      let comp main = read[int32] >>>
+        { h <- take; add_k(h) } >>> write[int32]
+    """)
+    xs = np.array([100, 1, 2, 3], np.int32)
+    res = run(prog.comp, list(xs))
+    np.testing.assert_array_equal(res.out_array(), [101, 102, 103])
+
+
+def test_let_comp_local():
+    prog = compile_source("""
+      let comp main = read[int32] >>> {
+        let comp dbl = repeat { x <- take; emit 2 * x };
+        dbl
+      } >>> write[int32]
+    """)
+    xs = np.arange(4, dtype=np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, 2 * xs)
+
+
+def test_top_level_comp_reference():
+    prog = compile_source("""
+      let comp stage1 = repeat { x <- take; emit x + 1 }
+      let comp main = read[int32] >>> stage1 >>> stage1 >>> write[int32]
+    """)
+    xs = np.arange(4, dtype=np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, xs + 2)
+
+
+# ------------------------------------------------------------- ext + types
+
+def test_ext_fft_roundtrip():
+    prog = compile_source("""
+      ext fun v_fft(x: arr[64] complex16) : arr[64] complex16
+      ext fun v_ifft(x: arr[64] complex16) : arr[64] complex16
+      fun comp spectral() {
+        repeat { (s: arr[64] complex16) <- takes 64;
+                 emits v_ifft(v_fft(s)) }
+      }
+      let comp main = read[complex16] >>> spectral() >>> write[complex16]
+    """)
+    assert prog.in_ty == "complex16" and prog.out_ty == "complex16"
+    rng = np.random.default_rng(1)
+    xs = rng.integers(-100, 100, (128, 2)).astype(np.int16)
+    out = both_backends(prog, xs)
+    np.testing.assert_allclose(out, xs, atol=1.0)  # int16 round-trip
+
+
+def test_double_and_cast():
+    prog = compile_source("""
+      fun scale(x: int16) : double { return double(x) * 0.5 }
+      let comp main = read[int16] >>> map scale >>> write[double]
+    """)
+    assert prog.out_ty == "float32"
+    xs = np.arange(-4, 4, dtype=np.int16)
+    out = both_backends(prog, xs)
+    np.testing.assert_allclose(out, xs * 0.5)
+
+
+def test_int16_wraparound():
+    prog = compile_source("""
+      fun bump(x: int16) : int16 { return x + 1 }
+      let comp main = read[int16] >>> map bump >>> write[int16]
+    """)
+    xs = np.array([32767, -32768, 0], np.int16)
+    res = run(prog.comp, list(xs))
+    np.testing.assert_array_equal(res.out_array().astype(np.int16),
+                                  [-32768, -32767, 1])
+
+
+def test_struct_roundtrip():
+    prog = compile_source("""
+      struct Pkt = { hi: int32; lo: int32 }
+      fun pack(x: int32) : int32 {
+        var p : Pkt := Pkt { hi = x / 256, lo = x % 256 };
+        return p.hi * 256 + p.lo
+      }
+      let comp main = read[int32] >>> map pack >>> write[int32]
+    """)
+    xs = np.array([0, 511, 70000], np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, xs)
+
+
+def test_typecheck_elaborated_ir():
+    prog = compile_source("""
+      let comp main = read[int32] >>> repeat { x <- take; emit x }
+                      >>> write[int32]
+    """)
+    t = typecheck(prog.comp)
+    assert t.kind() == "transformer"
+
+
+# ------------------------------------------------------------------ errors
+
+def test_unbound_variable_reports_loc():
+    with pytest.raises(ElabError, match="unbound"):
+        compile_source("let comp main = read[bit] >>> "
+                       "repeat { x <- take; emit y } >>> write[bit]")
+
+
+def test_unknown_ext():
+    with pytest.raises(ElabError, match="registry"):
+        compile_source("ext fun warp_core(x: int32) : int32\n"
+                       "let comp main = read[int32] >>> map warp_core "
+                       ">>> write[int32]")
+
+
+def test_emits_unknown_length():
+    with pytest.raises(ElabError, match="emits"):
+        compile_source("""
+          let comp main = read[int32] >>>
+            repeat { x <- take; emits x } >>> write[int32]
+        """)
+
+
+def test_runtime_error_has_position():
+    prog = compile_source("""
+      fun f(x: int32) : int32 { error "boom"; return x }
+      let comp main = read[int32] >>> map f >>> write[int32]
+    """)
+    with pytest.raises(ZiriaRuntimeError, match="boom"):
+        run(prog.comp, [np.int32(1)])
+
+
+def test_misplaced_read():
+    with pytest.raises(ElabError, match="pipeline ends"):
+        compile_source("let comp main = repeat { x <- take; emit x } "
+                       ">>> read[bit] >>> write[bit]")
+
+
+# ----------------------------------------------------- review regressions
+
+def test_runtime_bind_shadows_static_global():
+    """A take-bound name shadowing a top-level let must NOT constant-fold
+    to the global's value."""
+    prog = compile_source("""
+      let k = 3
+      let comp main = read[int32] >>> repeat { k <- take; emit k }
+                      >>> write[int32]
+    """)
+    xs = np.array([10, 20, 30], np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, xs)
+
+
+def test_local_array_with_named_length_assign():
+    prog = compile_source("""
+      let N = 4
+      fun f(x: int32) : int32 {
+        var acc : arr[N] int32;
+        acc[0] := x;
+        return acc[0] + acc[3]
+      }
+      let comp main = read[int32] >>> map f >>> write[int32]
+    """)
+    xs = np.array([7, 9], np.int32)
+    out = both_backends(prog, xs)
+    np.testing.assert_array_equal(out, xs)
+
+
+def test_times_with_named_count_parses():
+    prog = compile_source("""
+      let n = 2
+      let comp main = read[int32] >>>
+        { times n { x <- take; emit x + 1 }; emit 99 } >>> write[int32]
+    """)
+    res = run(prog.comp, list(np.array([5, 6], np.int32)))
+    np.testing.assert_array_equal(res.out_array(), [6, 7, 99])
+
+
+def test_bad_hex_literal_is_lex_error():
+    from ziria_tpu.frontend import LexError
+    with pytest.raises(LexError, match="hex"):
+        compile_source("let x = 0x\nlet comp main = read[bit] >>> "
+                       "repeat { b <- take; emit b } >>> write[bit]")

@@ -1,0 +1,171 @@
+"""Lexer/parser tests for the surface syntax (SURVEY.md §2.1 frontend)."""
+
+import pytest
+
+from ziria_tpu.frontend import (LexError, ParseError, parse_comp,
+                                parse_expr, parse_program, tokenize)
+from ziria_tpu.frontend import lexer
+from ziria_tpu.frontend import ast as A
+
+
+# ------------------------------------------------------------------- lexer
+
+def test_lex_ops_longest_match():
+    toks = tokenize("a |>>>| b >>> c := d <- e << f <= g")
+    ops = [t.text for t in toks if t.kind == "op"]
+    assert ops == ["|>>>|", ">>>", ":=", "<-", "<<", "<="]
+
+
+def test_lex_bit_and_numbers():
+    toks = tokenize("'0 '1 42 0x1F 3.5 2e-3 1.")
+    kinds = [(t.kind, t.text) for t in toks[:-1]]
+    assert ("bit", "0") in kinds and ("bit", "1") in kinds
+    assert ("int", "42") in kinds and ("int", "0x1F") in kinds
+    assert ("float", "3.5") in kinds and ("float", "2e-3") in kinds
+    # "1." lexes as int 1 then op '.' (field access needs this)
+    assert kinds[-2:] == [("int", "1"), ("op", ".")]
+
+
+def test_lex_comments():
+    toks = tokenize("a -- line comment\nb {- block {- nested -} -} c // x")
+    ids = [t.text for t in toks if t.kind == "id"]
+    assert ids == ["a", "b", "c"]
+
+
+def test_lex_string_escape():
+    toks = tokenize('"he\\"llo\\n"')
+    assert toks[0].kind == "str" and toks[0].text == 'he"llo\n'
+
+
+def test_lex_error_position():
+    with pytest.raises(LexError, match="2:3"):
+        tokenize("ab\nc `d")
+
+
+# ------------------------------------------------------------------- exprs
+
+def test_expr_precedence():
+    e = parse_expr("1 + 2 * 3 == 7 && true")
+    assert isinstance(e, A.EBin) and e.op == "&&"
+    assert isinstance(e.a, A.EBin) and e.a.op == "=="
+    assert isinstance(e.a.a, A.EBin) and e.a.a.op == "+"
+    assert isinstance(e.a.a.b, A.EBin) and e.a.a.b.op == "*"
+
+
+def test_expr_slice_index_field():
+    e = parse_expr("x[3, 4]")
+    assert isinstance(e, A.ESlice)
+    e = parse_expr("x[i].re")
+    assert isinstance(e, A.EField) and e.f == "re"
+    assert isinstance(e.e, A.EIdx)
+
+
+def test_expr_cast_and_arrlit():
+    e = parse_expr("int16({1, 2, 3})")
+    assert isinstance(e, A.ECall) and e.name == "int16"
+    assert isinstance(e.args[0], A.EArrLit) and len(e.args[0].elems) == 3
+
+
+def test_expr_cond():
+    e = parse_expr("if a > 0 then b else c")
+    assert isinstance(e, A.ECond)
+
+
+# ------------------------------------------------------------------- comps
+
+def test_comp_pipe_assoc_and_par():
+    c = parse_comp("a >>> b |>>>| c")
+    assert isinstance(c, A.CPipe) and c.par
+    assert isinstance(c.up, A.CPipe) and not c.up.par
+
+
+def test_comp_block_binds():
+    c = parse_comp("{ x <- take; emit x + 1 }")
+    assert isinstance(c, A.CBind) and c.var == "x"
+    assert isinstance(c.first, A.CTake)
+    assert isinstance(c.rest, A.CEmit)
+
+
+def test_comp_typed_bind():
+    c = parse_comp("{ (x: arr[64] complex16) <- takes 64; emits x }")
+    assert isinstance(c, A.CBind) and c.var == "x"
+    assert isinstance(c.var_ty, A.TArr)
+
+
+def test_comp_repeat_var_do():
+    c = parse_comp("""
+      { var st : arr[7] bit := {'1,'1,'1,'1,'1,'1,'1};
+        repeat {
+          x <- take;
+          do { st[0] := x };
+          emit x
+        }
+      }""")
+    assert isinstance(c, A.CVarDecl)
+    assert isinstance(c.rest, A.CRepeat)
+
+
+def test_comp_block_must_end_in_comp():
+    with pytest.raises(ParseError, match="end with a computation"):
+        parse_comp("{ emit 1; var x : bit := '0 }")
+
+    with pytest.raises(ParseError, match="cannot be a bind"):
+        parse_comp("{ x <- take }")
+
+
+def test_comp_control():
+    c = parse_comp("for i in [0, 8] { emit i }")
+    assert isinstance(c, A.CFor)
+    c = parse_comp("while (n > 0) { emit n }")
+    assert isinstance(c, A.CWhile)
+    c = parse_comp("until (done) { x <- take; emit x }")
+    assert isinstance(c, A.CUntil)
+    c = parse_comp("times 4 take")
+    assert isinstance(c, A.CTimes)
+    c = parse_comp("if r > 1 then map f else map g")
+    assert isinstance(c, A.CIf)
+
+
+def test_comp_read_write():
+    c = parse_comp("read[complex16] >>> map f >>> write[bit]")
+    assert isinstance(c.up.up, A.CRead)
+    assert isinstance(c.down, A.CWrite)
+
+
+# ------------------------------------------------------------------- decls
+
+def test_program_decls():
+    p = parse_program("""
+      struct Hdr = { rate: int32; len: int32 }
+      let n = 64
+      ext fun v_fft(x: arr[64] complex16) : arr[64] complex16
+      fun f(x: int16) : int16 { return x + 1 }
+      fun comp pipe_a(k: int32) { repeat { x <- take; emit x + k } }
+      let comp main = read[int16] >>> pipe_a(3) >>> write[int16]
+    """)
+    kinds = [type(d).__name__ for d in p.decls]
+    assert kinds == ["DStruct", "DLet", "DExt", "DFun", "DFunComp",
+                     "DLetComp"]
+    fc = p.decls[4]
+    assert fc.name == "pipe_a" and fc.params[0].name == "k"
+
+
+def test_parse_error_position():
+    with pytest.raises(ParseError, match="3:"):
+        parse_program("let x = 1\nlet y = 2\nfun ( broken")
+
+
+def test_stmt_forms():
+    p = parse_program("""
+      fun g(a: arr[4] int32) : int32 {
+        var acc : int32 := 0;
+        for i in [0, 4] { acc := acc + a[i] };
+        while (acc > 100) { acc := acc - 100 };
+        if acc > 10 then { acc := acc - 1 } else { acc := acc + 1 };
+        println "acc=", acc;
+        return acc
+      }
+    """)
+    body = p.decls[0].body
+    names = [type(s).__name__ for s in body]
+    assert names == ["SVar", "SFor", "SWhile", "SIf", "SExpr", "SReturn"]

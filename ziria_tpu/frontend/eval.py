@@ -1,0 +1,662 @@
+"""Staged evaluator for the surface expression language.
+
+This is the expression-level *code generator*: it executes expression
+and statement ASTs over jnp values, so running it eagerly gives the
+interpreter semantics and running it under a `jax.jit` trace stages the
+very same AST into an XLA graph (classic staged interpretation — the
+TPU-first replacement for the reference's `CgExpr.hs` C emitter,
+SURVEY.md §2.1).
+
+Value representation / dtype policy:
+
+  bit        Python int 0/1 (static) or jnp uint8
+  bool       Python bool or jnp bool_
+  int{8,16,32,64}, int   jnp integer scalars (wrap-around = C semantics);
+             *literals and untyped lets stay Python ints* so that array
+             lengths, take counts and loop bounds remain static under
+             tracing
+  double     float32 (TPU dtype policy — f64 would disable the MXU path;
+             the golden-file differ absorbs the precision delta)
+  complex{16,32}, complex  jnp complex64; `.re`/`.im` field access
+  arr[n] t   jnp array; mutation via functional `.at[...]` updates
+  struct     dict {field: value} tagged with "__struct__"
+
+Static Python scalars flow through arithmetic unchanged (int+int=int),
+which is what keeps `takes (n*2)` and `for i in [0, n]` compile-time
+constants; anything touching a jnp value promotes to jnp.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ziria_tpu.frontend import ast as A
+
+
+class ZiriaRuntimeError(RuntimeError):
+    pass
+
+
+class NotStatic(Exception):
+    """Raised by the static-evaluation entry when a value is runtime."""
+
+
+def _rt_err(loc: Tuple[int, int], msg: str) -> ZiriaRuntimeError:
+    return ZiriaRuntimeError(f"{loc[0]}:{loc[1]}: {msg}")
+
+
+# --------------------------------------------------------------------------
+# Types → dtypes / casts
+# --------------------------------------------------------------------------
+
+_INT_DTYPES = {"int8": np.int8, "int16": np.int16, "int32": np.int32,
+               "int64": np.int64, "int": np.int32}
+_CPLX = ("complex", "complex16", "complex32")
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def is_static(v: Any) -> bool:
+    return isinstance(v, (int, float, bool, complex)) and not hasattr(
+        v, "dtype")
+
+
+def base_dtype(name: str):
+    jnp = _jnp()
+    if name == "bit":
+        return jnp.uint8
+    if name == "bool":
+        return jnp.bool_
+    if name in _INT_DTYPES:
+        return jnp.dtype(_INT_DTYPES[name])
+    if name == "double":
+        return jnp.float32
+    if name in _CPLX:
+        return jnp.complex64
+    raise ValueError(f"no dtype for base type {name!r}")
+
+
+@dataclass
+class StructDef:
+    name: str
+    fields: Tuple[Tuple[str, A.Ty], ...]
+
+
+def cast_value(ty: Optional[A.Ty], v: Any, structs: Dict[str, StructDef],
+               static_eval: Optional[Callable] = None) -> Any:
+    """Cast `v` to surface type `ty` (None = leave as-is)."""
+    if ty is None:
+        return v
+    jnp = _jnp()
+    if isinstance(ty, A.TBase):
+        if ty.name == "bit" and is_static(v):
+            return int(v) & 1
+        if ty.name in ("int", "int8", "int16", "int32", "int64") \
+                and is_static(v):
+            # static ints stay static, but wrap to the declared width
+            w = np.dtype(_INT_DTYPES[ty.name]).itemsize * 8
+            x = int(v) & ((1 << w) - 1)
+            return x - (1 << w) if x >= (1 << (w - 1)) else x
+        if ty.name == "bool" and is_static(v):
+            return bool(v)
+        if ty.name == "double" and is_static(v):
+            return float(v)
+        if ty.name in _CPLX and is_static(v):
+            return complex(v)
+        dt = base_dtype(ty.name)
+        if ty.name == "bit":
+            return jnp.asarray(v).astype(jnp.uint8) & jnp.uint8(1)
+        return jnp.asarray(v).astype(dt)
+    if isinstance(ty, A.TArr):
+        arr = jnp.asarray(v)
+        edt = base_dtype(ty.elem.name) if isinstance(ty.elem, A.TBase) \
+            else None
+        if edt is not None and arr.dtype != edt:
+            arr = arr.astype(edt)
+        if ty.n is not None and static_eval is not None:
+            n = static_eval(ty.n)
+            if int(arr.shape[0]) != int(n):
+                raise ZiriaRuntimeError(
+                    f"array of declared length {n} initialized with "
+                    f"length {arr.shape[0]}")
+        return arr
+    if isinstance(ty, A.TStruct):
+        sd = structs.get(ty.name)
+        if sd is None:
+            raise ZiriaRuntimeError(f"unknown struct type {ty.name!r}")
+        if not isinstance(v, dict):
+            raise ZiriaRuntimeError(
+                f"struct {ty.name} initialized with non-struct value")
+        out = {"__struct__": sd.name}
+        for fn, fty in sd.fields:
+            if fn not in v:
+                raise ZiriaRuntimeError(f"struct {sd.name} missing "
+                                        f"field {fn!r}")
+            out[fn] = cast_value(fty, v[fn], structs, static_eval)
+        return out
+    raise ZiriaRuntimeError(f"cannot cast to {ty}")
+
+
+def zero_value(ty: A.Ty, structs: Dict[str, StructDef],
+               static_eval: Callable) -> Any:
+    jnp = _jnp()
+    if isinstance(ty, A.TBase):
+        if ty.name == "bit":
+            return 0
+        if ty.name in _INT_DTYPES:
+            return 0
+        if ty.name == "bool":
+            return False
+        if ty.name == "double":
+            return 0.0
+        if ty.name in _CPLX:
+            return 0j
+        raise ZiriaRuntimeError(f"no zero value for {ty.name}")
+    if isinstance(ty, A.TArr):
+        if ty.n is None:
+            raise ZiriaRuntimeError(
+                "length-polymorphic array needs an initializer")
+        n = int(static_eval(ty.n))
+        if isinstance(ty.elem, A.TBase):
+            return jnp.zeros((n,), base_dtype(ty.elem.name))
+        inner = zero_value(ty.elem, structs, static_eval)
+        return jnp.zeros((n,) + tuple(np.shape(inner)),
+                         getattr(inner, "dtype", jnp.float32))
+    if isinstance(ty, A.TStruct):
+        sd = structs[ty.name]
+        return {"__struct__": sd.name,
+                **{fn: zero_value(fty, structs, static_eval)
+                   for fn, fty in sd.fields}}
+    raise ZiriaRuntimeError(f"no zero value for {ty}")
+
+
+# --------------------------------------------------------------------------
+# Scopes
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Cell:
+    value: Any
+    ty: Optional[A.Ty]
+    mutable: bool
+
+
+class Scope:
+    """Chained lexical scope over Cells; supports snapshot/merge for
+    staging dynamic `if` statements."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.cells: Dict[str, Cell] = {}
+        self.parent = parent
+
+    def child(self) -> "Scope":
+        return Scope(self)
+
+    def declare(self, name: str, value: Any, ty: Optional[A.Ty] = None,
+                mutable: bool = False) -> None:
+        self.cells[name] = Cell(value, ty, mutable)
+
+    def find(self, name: str) -> Optional[Cell]:
+        # recurse through parent.find (not a cells-walk) so subclasses
+        # (elab.RuntimeScope) can interpose env-backed lookups mid-chain
+        c = self.cells.get(name)
+        if c is not None:
+            return c
+        return self.parent.find(name) if self.parent is not None else None
+
+    def lookup(self, name: str, loc=(0, 0)) -> Any:
+        c = self.find(name)
+        if c is None:
+            raise _rt_err(loc, f"unbound variable {name!r}")
+        return c.value
+
+    def assign(self, name: str, value: Any, ctx: "Ctx", loc=(0, 0)) -> None:
+        # delegate up the chain so subclasses (RuntimeScope) can intercept
+        # at their own level — a find()-based set would write to temporary
+        # view cells and silently drop the store
+        if name in self.cells:
+            c = self.cells[name]
+            if not c.mutable:
+                raise _rt_err(loc, f"assignment to immutable binding "
+                                   f"{name!r} (declare it with `var`)")
+            c.value = cast_value(c.ty, value, ctx.structs,
+                                 lambda x: ctx.static_eval(x, self)) \
+                if c.ty is not None else value
+            return
+        if self.parent is not None:
+            return self.parent.assign(name, value, ctx, loc)
+        raise _rt_err(loc, f"assignment to unbound variable {name!r}")
+
+    def own_mutable_cells(self) -> List[Tuple[str, Any]]:
+        return [(n, c) for n, c in self.cells.items() if c.mutable]
+
+    def mutable_cells(self) -> List[Any]:
+        out, s, seen = [], self, set()
+        while s is not None:
+            for name, c in s.own_mutable_cells():
+                if name not in seen:
+                    seen.add(name)
+                    out.append(c)
+            s = s.parent
+        return out
+
+
+# --------------------------------------------------------------------------
+# Evaluation context
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class FunDef:
+    decl: A.DFun
+    closure: Scope           # scope the fun was defined in
+
+
+@dataclass
+class Ctx:
+    funs: Dict[str, FunDef] = field(default_factory=dict)
+    exts: Dict[str, Callable] = field(default_factory=dict)
+    structs: Dict[str, StructDef] = field(default_factory=dict)
+    on_print: Callable[[str], None] = print
+
+    def static_eval(self, e: A.Expr, scope: Optional[Scope] = None) -> Any:
+        """Evaluate `e` and require a static Python value (array lengths,
+        take counts, loop bounds)."""
+        v = eval_expr(e, scope or Scope(), self)
+        if hasattr(v, "dtype") and getattr(v, "shape", None) == ():
+            try:
+                v = v.item()
+            except Exception:
+                raise NotStatic(f"{e.loc[0]}:{e.loc[1]}: value is not "
+                                f"compile-time static")
+        if not is_static(v):
+            raise NotStatic(f"{e.loc[0]}:{e.loc[1]}: value is not "
+                            f"compile-time static")
+        return v
+
+
+# --------------------------------------------------------------------------
+# Operators
+# --------------------------------------------------------------------------
+
+
+def _trunc_div(a, b):
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+def _binop(op: str, a: Any, b: Any, loc) -> Any:
+    jnp = _jnp()
+    both_static = is_static(a) and is_static(b)
+    if op == "&&":
+        return (bool(a) and bool(b)) if both_static \
+            else jnp.logical_and(a, b)
+    if op == "||":
+        return (bool(a) or bool(b)) if both_static \
+            else jnp.logical_or(a, b)
+    if both_static:
+        try:
+            if op == "/":
+                if isinstance(a, int) and isinstance(b, int):
+                    return _trunc_div(a, b)     # C int division
+                return a / b
+            if op == "%":
+                if isinstance(a, int) and isinstance(b, int):
+                    return a - _trunc_div(a, b) * b   # C remainder
+                return math.fmod(a, b)
+            return {
+                "+": lambda: a + b, "-": lambda: a - b,
+                "*": lambda: a * b, "**": lambda: a ** b,
+                "<<": lambda: a << b, ">>": lambda: a >> b,
+                "<": lambda: a < b, "<=": lambda: a <= b,
+                ">": lambda: a > b, ">=": lambda: a >= b,
+                "==": lambda: a == b, "!=": lambda: a != b,
+                "&": lambda: a & b, "|": lambda: a | b,
+                "^": lambda: a ^ b,
+            }[op]()
+        except TypeError:
+            pass  # e.g. complex << int — fall through for the error below
+    from jax import lax
+    aj, bj = jnp.asarray(a), jnp.asarray(b)
+    if op in ("+", "-", "*", "**"):
+        return {"+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+                "**": jnp.power}[op](aj, bj)
+    if op == "/":
+        if (jnp.issubdtype(aj.dtype, jnp.integer)
+                and jnp.issubdtype(bj.dtype, jnp.integer)):
+            aj, bj = jnp.broadcast_arrays(aj, bj)
+            return lax.div(aj, bj)      # C-style truncating int division
+        return jnp.divide(aj, bj)
+    if op == "%":
+        aj, bj = jnp.broadcast_arrays(aj, bj)
+        return lax.rem(aj, bj)
+    if op == "<<":
+        return jnp.left_shift(aj, bj)
+    if op == ">>":
+        return jnp.right_shift(aj, bj)
+    if op in ("<", "<=", ">", ">=", "==", "!="):
+        return {"<": jnp.less, "<=": jnp.less_equal, ">": jnp.greater,
+                ">=": jnp.greater_equal, "==": jnp.equal,
+                "!=": jnp.not_equal}[op](aj, bj)
+    if op in ("&", "|", "^"):
+        if aj.dtype == jnp.bool_ and bj.dtype == jnp.bool_:
+            return {"&": jnp.logical_and, "|": jnp.logical_or,
+                    "^": jnp.logical_xor}[op](aj, bj)
+        return {"&": jnp.bitwise_and, "|": jnp.bitwise_or,
+                "^": jnp.bitwise_xor}[op](aj, bj)
+    raise _rt_err(loc, f"unknown operator {op!r}")
+
+
+# --------------------------------------------------------------------------
+# Expression evaluation
+# --------------------------------------------------------------------------
+
+_BASE_TYPE_NAMES = frozenset(
+    ("bit", "bool", "int", "int8", "int16", "int32", "int64", "double",
+     "complex", "complex16", "complex32"))
+
+
+def eval_expr(e: A.Expr, scope: Scope, ctx: Ctx) -> Any:
+    jnp = _jnp()
+    if isinstance(e, A.EInt):
+        return e.val
+    if isinstance(e, A.EFloat):
+        return e.val
+    if isinstance(e, A.EBit):
+        return e.val
+    if isinstance(e, A.EBool):
+        return e.val
+    if isinstance(e, A.EString):
+        return e.val
+    if isinstance(e, A.EVar):
+        return scope.lookup(e.name, e.loc)
+    if isinstance(e, A.EUn):
+        v = eval_expr(e.e, scope, ctx)
+        if e.op == "-":
+            return -v if is_static(v) else _jnp().negative(v)
+        if e.op == "~":
+            return ~v if is_static(v) else _jnp().bitwise_not(v)
+        if e.op == "!":
+            return (not v) if is_static(v) else _jnp().logical_not(v)
+        raise _rt_err(e.loc, f"unknown unary {e.op!r}")
+    if isinstance(e, A.EBin):
+        return _binop(e.op, eval_expr(e.a, scope, ctx),
+                      eval_expr(e.b, scope, ctx), e.loc)
+    if isinstance(e, A.ECond):
+        c = eval_expr(e.c, scope, ctx)
+        if is_static(c):
+            return eval_expr(e.a if c else e.b, scope, ctx)
+        a = eval_expr(e.a, scope, ctx)
+        b = eval_expr(e.b, scope, ctx)
+        return jnp.where(c, a, b)
+    if isinstance(e, A.ECall):
+        return _eval_call(e, scope, ctx)
+    if isinstance(e, A.EIdx):
+        arr = eval_expr(e.arr, scope, ctx)
+        i = eval_expr(e.i, scope, ctx)
+        if isinstance(arr, dict):
+            raise _rt_err(e.loc, "cannot index a struct")
+        return arr[i] if is_static(i) else jnp.asarray(arr)[i]
+    if isinstance(e, A.ESlice):
+        arr = jnp.asarray(eval_expr(e.arr, scope, ctx))
+        i = eval_expr(e.i, scope, ctx)
+        try:
+            n = ctx.static_eval(e.n, scope)
+        except NotStatic:
+            raise _rt_err(e.n.loc, "slice length must be compile-time "
+                                   "static (x[i, n] with static n)")
+        if is_static(i):
+            i = int(i)
+            if i < 0 or i + n > arr.shape[0]:
+                raise _rt_err(e.loc, f"slice [{i}, {n}] out of bounds for "
+                                     f"array of length {arr.shape[0]}")
+            return arr[i:i + int(n)]
+        from jax import lax
+        return lax.dynamic_slice_in_dim(arr, i, int(n))
+    if isinstance(e, A.EField):
+        v = eval_expr(e.e, scope, ctx)
+        if isinstance(v, dict):
+            if e.f not in v:
+                raise _rt_err(e.loc, f"struct {v.get('__struct__')} has "
+                                     f"no field {e.f!r}")
+            return v[e.f]
+        if e.f == "re":
+            return v.real if is_static(v) else jnp.real(v)
+        if e.f == "im":
+            return v.imag if is_static(v) else jnp.imag(v)
+        raise _rt_err(e.loc, f"no field {e.f!r} on a non-struct value")
+    if isinstance(e, A.EArrLit):
+        vals = [eval_expr(x, scope, ctx) for x in e.elems]
+        if all(is_static(v) for v in vals):
+            return jnp.asarray(np.array(vals))
+        return jnp.stack([jnp.asarray(v) for v in vals])
+    if isinstance(e, A.EStructLit):
+        sd = ctx.structs.get(e.name)
+        if sd is None:
+            raise _rt_err(e.loc, f"unknown struct {e.name!r}")
+        v = {fn: eval_expr(fe, scope, ctx) for fn, fe in e.fields}
+        return cast_value(A.TStruct(e.name), v, ctx.structs,
+                          lambda x: ctx.static_eval(x, scope))
+    raise _rt_err(getattr(e, "loc", (0, 0)),
+                  f"unknown expression node {type(e).__name__}")
+
+
+def _eval_call(e: A.ECall, scope: Scope, ctx: Ctx) -> Any:
+    jnp = _jnp()
+    args = [eval_expr(a, scope, ctx) for a in e.args]
+    name = e.name
+    # casts / complex constructors
+    if name in _BASE_TYPE_NAMES:
+        if name in _CPLX and len(args) == 2:
+            re, im = args
+            if is_static(re) and is_static(im):
+                return complex(re, im)
+            return (jnp.asarray(re, jnp.float32)
+                    + 1j * jnp.asarray(im, jnp.float32)).astype(
+                        jnp.complex64)
+        if len(args) != 1:
+            raise _rt_err(e.loc, f"cast {name} takes one argument")
+        return cast_value(A.TBase(name), args[0], ctx.structs,
+                          lambda x: ctx.static_eval(x, scope))
+    # user expression functions
+    fd = ctx.funs.get(name)
+    if fd is not None:
+        return call_fun(fd, args, ctx, e.loc)
+    # ext / builtin functions
+    fn = ctx.exts.get(name)
+    if fn is not None:
+        return fn(*args)
+    # print family
+    if name in ("print", "println", "error"):
+        msg = "".join(_fmt_value(a) for a in args)
+        if name == "error":
+            raise ZiriaRuntimeError(f"error: {msg}")
+        ctx.on_print(msg + ("\n" if name == "println" else ""))
+        return None
+    raise _rt_err(e.loc, f"unknown function {name!r}")
+
+
+def _fmt_value(v: Any) -> str:
+    if hasattr(v, "dtype") and getattr(v, "shape", None) == ():
+        try:
+            v = v.item()
+        except Exception:
+            pass
+    return str(v)
+
+
+def call_fun(fd: FunDef, args: List[Any], ctx: Ctx, loc=(0, 0)) -> Any:
+    d = fd.decl
+    if len(args) != len(d.params):
+        raise _rt_err(loc, f"{d.name}: expected {len(d.params)} args, "
+                           f"got {len(args)}")
+    s = fd.closure.child()
+    for p, v in zip(d.params, args):
+        ty = p.ty
+        # length-polymorphic array params adopt the argument's length
+        if ty is not None:
+            v = cast_value(ty, v, ctx.structs,
+                           lambda x: ctx.static_eval(x, fd.closure))
+        s.declare(p.name, v, ty, mutable=False)
+    r = exec_stmts(d.body, s, ctx)
+    v = r[1] if r is not None else None
+    if d.ret_ty is not None and v is not None:
+        v = cast_value(d.ret_ty, v, ctx.structs,
+                       lambda x: ctx.static_eval(x, fd.closure))
+    return v
+
+
+# --------------------------------------------------------------------------
+# Statement execution
+# --------------------------------------------------------------------------
+
+
+def exec_stmts(stmts, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
+    """Run statements; returns ('ret', v) if a `return` fired, else None."""
+    for st in stmts:
+        r = exec_stmt(st, scope, ctx)
+        if r is not None:
+            return r
+    return None
+
+
+def exec_stmt(st: A.Stmt, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
+    jnp = _jnp()
+    if isinstance(st, A.SVar):
+        se = lambda x: ctx.static_eval(x, scope)   # noqa: E731
+        if st.init is not None:
+            v = cast_value(st.ty, eval_expr(st.init, scope, ctx),
+                           ctx.structs, se)
+        else:
+            v = zero_value(st.ty, ctx.structs, se)
+        scope.declare(st.name, v, st.ty, mutable=True)
+        return None
+    if isinstance(st, A.SLet):
+        v = eval_expr(st.e, scope, ctx)
+        if st.ty is not None:
+            v = cast_value(st.ty, v, ctx.structs,
+                           lambda x: ctx.static_eval(x, scope))
+        scope.declare(st.name, v, st.ty, mutable=False)
+        return None
+    if isinstance(st, A.SAssign):
+        v = eval_expr(st.e, scope, ctx)
+        _assign_lval(st.lval, v, scope, ctx)
+        return None
+    if isinstance(st, A.SIf):
+        c = eval_expr(st.c, scope, ctx)
+        if is_static(c):
+            return exec_stmts(st.then if c else st.els, scope.child(), ctx)
+        try:
+            cb = bool(c)           # eager (interpreter) path: concrete
+        except Exception:
+            return _staged_if(c, st, scope, ctx)   # traced: where-merge
+        return exec_stmts(st.then if cb else st.els, scope.child(), ctx)
+    if isinstance(st, A.SFor):
+        try:
+            start = ctx.static_eval(st.start, scope)
+            count = ctx.static_eval(st.count, scope)
+        except NotStatic:
+            raise _rt_err(st.loc, "for-loop bounds must be compile-time "
+                                  "static (use while for dynamic trip "
+                                  "counts)")
+        for i in range(int(start), int(start) + int(count)):
+            s = scope.child()
+            s.declare(st.var, i, None, mutable=False)
+            r = exec_stmts(st.body, s, ctx)
+            if r is not None:
+                return r
+        return None
+    if isinstance(st, A.SWhile):
+        while True:
+            c = eval_expr(st.c, scope, ctx)
+            try:
+                c = bool(c)
+            except Exception:
+                raise _rt_err(
+                    st.loc, "while condition is data-dependent under "
+                            "tracing; dynamic while-loops run on the "
+                            "interpreter backend only")
+            if not c:
+                return None
+            r = exec_stmts(st.body, scope.child(), ctx)
+            if r is not None:
+                return r
+    if isinstance(st, A.SReturn):
+        return ("ret", eval_expr(st.e, scope, ctx))
+    if isinstance(st, A.SExpr):
+        eval_expr(st.e, scope, ctx)
+        return None
+    raise _rt_err(st.loc, f"unknown statement {type(st).__name__}")
+
+
+def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):
+    """Dynamic-condition `if`: run both arms on the live scope, snapshot
+    mutable cells around each, and merge assigned cells with jnp.where —
+    the staging of imperative control flow into select ops."""
+    jnp = _jnp()
+    cells = scope.mutable_cells()
+    before = [c.value for c in cells]
+
+    r1 = exec_stmts(st.then, scope.child(), ctx)
+    after_then = [c.value for c in cells]
+    for c, v in zip(cells, before):
+        c.value = v
+    r2 = exec_stmts(st.els, scope.child(), ctx)
+    after_else = [c.value for c in cells]
+
+    if r1 is not None or r2 is not None:
+        raise _rt_err(st.loc, "return inside a data-dependent if is not "
+                              "supported under staging")
+    for c, b, t, f in zip(cells, before, after_then, after_else):
+        if t is b and f is b:
+            continue
+        c.value = jnp.where(cond, jnp.asarray(t), jnp.asarray(f))
+    return None
+
+
+def _assign_lval(lval: A.Expr, v: Any, scope: Scope, ctx: Ctx) -> None:
+    jnp = _jnp()
+    if isinstance(lval, A.EVar):
+        scope.assign(lval.name, v, ctx, lval.loc)
+        return
+    if isinstance(lval, A.EIdx):
+        old = eval_expr(lval.arr, scope, ctx)
+        i = eval_expr(lval.i, scope, ctx)
+        new = jnp.asarray(old).at[i].set(
+            jnp.asarray(v, dtype=jnp.asarray(old).dtype))
+        _assign_lval(lval.arr, new, scope, ctx)
+        return
+    if isinstance(lval, A.ESlice):
+        old = jnp.asarray(eval_expr(lval.arr, scope, ctx))
+        i = eval_expr(lval.i, scope, ctx)
+        try:
+            n = ctx.static_eval(lval.n, scope)
+        except NotStatic:
+            raise _rt_err(lval.loc, "slice length must be static")
+        vv = jnp.asarray(v, dtype=old.dtype)
+        vv = jnp.broadcast_to(vv, (int(n),) + old.shape[1:])
+        if is_static(i):
+            new = old.at[int(i):int(i) + int(n)].set(vv)
+        else:
+            from jax import lax
+            new = lax.dynamic_update_slice_in_dim(old, vv, i, axis=0)
+        _assign_lval(lval.arr, new, scope, ctx)
+        return
+    if isinstance(lval, A.EField):
+        old = eval_expr(lval.e, scope, ctx)
+        if not isinstance(old, dict):
+            raise _rt_err(lval.loc, "field assignment on a non-struct")
+        new = dict(old)
+        new[lval.f] = v
+        _assign_lval(lval.e, new, scope, ctx)
+        return
+    raise _rt_err(getattr(lval, "loc", (0, 0)),
+                  f"invalid assignment target {type(lval).__name__}")

@@ -1,0 +1,99 @@
+"""Externals registry: the frontend's `ext fun` binding surface.
+
+Counterpart of the reference's `lib/` ext declarations binding SORA C
+functions into the language (SURVEY.md §2.3) — here each name binds to a
+jnp implementation, so `ext fun v_fft(...)` in a source program resolves
+to `jnp.fft.fft` instead of a SORA SSE brick. A program must still
+*declare* the ext funs it uses (declarations are checked against this
+registry), keeping source files self-describing like the reference's.
+
+Builtins (`length`, `abs`, ...) are available without declaration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _length(x) -> int:
+    shape = np.shape(x)
+    if not shape:
+        raise ValueError("length() of a scalar")
+    return int(shape[0])
+
+
+def _f(fn_name: str) -> Callable:
+    def wrapper(*args):
+        jnp = _jnp()
+        return getattr(jnp, fn_name)(*[jnp.asarray(a) for a in args])
+    wrapper.__name__ = fn_name
+    return wrapper
+
+
+def _fft(x):
+    jnp = _jnp()
+    return jnp.fft.fft(jnp.asarray(x, jnp.complex64)).astype(jnp.complex64)
+
+
+def _ifft(x):
+    jnp = _jnp()
+    return jnp.fft.ifft(jnp.asarray(x, jnp.complex64)).astype(jnp.complex64)
+
+
+def _sum(x):
+    return _jnp().sum(_jnp().asarray(x), axis=0)
+
+
+# always available, no declaration needed
+BUILTINS: Dict[str, Callable] = {
+    "length": _length,
+    "abs": _f("abs"),
+    "min": _f("minimum"),
+    "max": _f("maximum"),
+    "sum": _sum,
+}
+
+# available via `ext fun` declaration (names mirror the reference's lib/)
+EXTERNALS: Dict[str, Callable] = {
+    "sqrt": _f("sqrt"),
+    "log": _f("log"),
+    "exp": _f("exp"),
+    "sin": _f("sin"),
+    "cos": _f("cos"),
+    "tan": _f("tan"),
+    "atan": _f("arctan"),
+    "atan2": _f("arctan2"),
+    "round_int": lambda x: _jnp().round(_jnp().asarray(x)).astype(
+        _jnp().int32),
+    "floor": _f("floor"),
+    "ceil": _f("ceil"),
+    "conj": _f("conj"),
+    # SORA-style vector DSP (SURVEY.md §2.2 sora_ext_lib.c equivalents)
+    "v_fft": _fft,
+    "v_ifft": _ifft,
+    "fft": _fft,
+    "ifft": _ifft,
+}
+
+
+def register_external(name: str, fn: Callable) -> None:
+    """Extend the registry (used by ops/ext_math and user code)."""
+    EXTERNALS[name] = fn
+
+
+def resolve_ext(name: str) -> Callable:
+    fn = EXTERNALS.get(name)
+    if fn is None:
+        known = ", ".join(sorted(EXTERNALS))
+        raise KeyError(
+            f"ext fun {name!r} is not in the externals registry "
+            f"(known: {known}); register it with "
+            f"ziria_tpu.frontend.externals.register_external")
+    return fn

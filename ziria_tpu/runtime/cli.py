@@ -132,14 +132,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-file-name")
     p.add_argument("--input-file-mode", default="dbg",
                    choices=["dbg", "bin"])
-    p.add_argument("--input-type", default="int32", choices=ITEM_TYPES)
+    p.add_argument("--input-type", default=None, choices=ITEM_TYPES,
+                   help="item type (default: from the program's read[t], "
+                        "else int32)")
     p.add_argument("--dummy-samples", type=int, default=0)
 
     p.add_argument("--output", default="file", choices=["file", "dummy"])
     p.add_argument("--output-file-name")
     p.add_argument("--output-file-mode", default="dbg",
                    choices=["dbg", "bin"])
-    p.add_argument("--output-type", default="int32", choices=ITEM_TYPES)
+    p.add_argument("--output-type", default=None, choices=ITEM_TYPES,
+                   help="item type (default: from the program's write[t], "
+                        "else int32)")
 
     p.add_argument("--backend", default="jit", choices=["interp", "jit"])
     p.add_argument("--width", type=int, default=None,
@@ -154,21 +158,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _resolve_prog(args):
+    """Returns (comp, default_in_ty, default_out_ty)."""
     if args.src:
-        try:
-            from ziria_tpu.frontend import compile_file
-        except ImportError:
-            raise SystemExit(
-                "--src: the textual frontend is not available in this "
-                "build; use --prog=NAME (--list-progs to enumerate)")
-        return compile_file(args.src)
+        from ziria_tpu.frontend import compile_file
+        prog = compile_file(args.src)
+        return prog.comp, prog.in_ty, prog.out_ty
     if not args.prog:
         raise SystemExit("need --prog=NAME or --src=FILE "
                          "(--list-progs to enumerate)")
     if args.prog not in PROGS:
         raise SystemExit(
             f"unknown prog {args.prog!r}; known: {', '.join(sorted(PROGS))}")
-    return PROGS[args.prog]()
+    return PROGS[args.prog](), None, None
 
 
 def main(argv=None) -> int:
@@ -178,7 +179,9 @@ def main(argv=None) -> int:
             print(name)
         return 0
 
-    comp = _resolve_prog(args)
+    comp, src_in_ty, src_out_ty = _resolve_prog(args)
+    in_ty = args.input_type or src_in_ty or "int32"
+    out_ty = args.output_type or src_out_ty or "int32"
 
     # autolut first: fold's map-map fusion erases in_domain declarations,
     # so the LUT rewrite must see the maps before they fuse
@@ -191,11 +194,11 @@ def main(argv=None) -> int:
     if args.ddump_fold:
         print(comp, file=sys.stderr)
 
-    in_spec = StreamSpec(kind=args.input, ty=args.input_type,
+    in_spec = StreamSpec(kind=args.input, ty=in_ty,
                          path=args.input_file_name,
                          mode=args.input_file_mode,
                          dummy_items=args.dummy_samples)
-    out_spec = StreamSpec(kind=args.output, ty=args.output_type,
+    out_spec = StreamSpec(kind=args.output, ty=out_ty,
                           path=args.output_file_name,
                           mode=args.output_file_mode)
 
