@@ -1,0 +1,88 @@
+"""Fixed-point math library tests (ops/ext_math.py — the reference's
+ext_math.c equivalents, SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.ops import ext_math as xm
+
+
+def test_sin_cos_int16_accuracy():
+    a = np.arange(-32768, 32768, 17, dtype=np.int16)
+    got_s = np.asarray(xm.sin_int16(a)).astype(np.float64) / 16384.0
+    got_c = np.asarray(xm.cos_int16(a)).astype(np.float64) / 16384.0
+    th = xm.q15_to_rad(a)
+    # one LUT step of error budget (2π/1024 rad)
+    assert np.max(np.abs(got_s - np.sin(th))) < 7e-3
+    assert np.max(np.abs(got_c - np.cos(th))) < 7e-3
+
+
+def test_sin_int16_wraps_like_phase():
+    """int16 overflow of the angle is phase wrap — the point of Q15."""
+    a = np.int16(32000)
+    step = np.int16(2000)    # wraps past +32767
+    wrapped = np.asarray(xm.sin_int16(
+        np.array(int(a) + int(step), np.int64).astype(np.int16)))
+    direct = np.asarray(xm.sin_int16(
+        xm.rad_to_q15(xm.q15_to_rad(a) + xm.q15_to_rad(step))))
+    assert abs(int(wrapped) - int(direct)) <= 32  # 1 LUT step
+
+
+def test_atan2_int16_roundtrip():
+    rng = np.random.default_rng(0)
+    th = rng.uniform(-np.pi, np.pi, 512)
+    r = rng.uniform(100, 30000, 512)
+    y = np.round(r * np.sin(th)).astype(np.int16)
+    x = np.round(r * np.cos(th)).astype(np.int16)
+    got = xm.q15_to_rad(np.asarray(xm.atan2_int16(y, x)))
+    want = np.arctan2(y.astype(np.float64), x.astype(np.float64))
+    d = np.angle(np.exp(1j * (got - want)))
+    assert np.max(np.abs(d)) < 2e-3
+
+
+def test_usqrt_exact():
+    x = np.concatenate([np.arange(0, 4096),
+                        np.array([2**31 - 1, 2**30, 999999937])])
+    got = np.asarray(xm.usqrt(x.astype(np.int32)))
+    want = np.floor(np.sqrt(x.astype(np.float64))).astype(np.int64)
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_ulog2_exact():
+    x = np.concatenate([np.arange(1, 4096),
+                        2 ** np.arange(1, 31),
+                        2 ** np.arange(2, 31) - 1]).astype(np.int32)
+    got = np.asarray(xm.ulog2(x))
+    want = np.floor(np.log2(x.astype(np.float64))).astype(np.int64)
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_jit_traceable():
+    import jax
+
+    @jax.jit
+    def f(a, y, x):
+        return xm.sin_int16(a), xm.atan2_int16(y, x), xm.usqrt(x)
+
+    a = np.arange(64, dtype=np.int16)
+    out = f(a, a, (a + 1).astype(np.int32))
+    assert all(np.asarray(o).shape == (64,) for o in out)
+
+
+def test_zir_source_can_declare_ext_math():
+    """`.zir` programs bind the fixed-point library via ext fun."""
+    from ziria_tpu.frontend import compile_source
+    from ziria_tpu.interp.interp import run
+    from ziria_tpu.backend.execute import run_jit
+
+    prog = compile_source("""
+      ext fun sin_int16(a: int16) : int16
+      let comp main = read[int16] >>> map sin_int16 >>> write[int16]
+    """)
+    a = np.arange(-512, 512, 8, dtype=np.int16)
+    ref = run(prog.comp, list(a)).out_array()
+    got = run_jit(prog.comp, a)
+    np.testing.assert_array_equal(np.asarray(ref, np.int64),
+                                  np.asarray(got, np.int64))
+    np.testing.assert_array_equal(np.asarray(ref),
+                                  np.asarray(xm.sin_int16(a)))

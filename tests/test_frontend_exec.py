@@ -415,3 +415,40 @@ def test_bad_hex_literal_is_lex_error():
     with pytest.raises(LexError, match="hex"):
         compile_source("let x = 0x\nlet comp main = read[bit] >>> "
                        "repeat { b <- take; emit b } >>> write[bit]")
+
+
+def test_comp_fun_arg_not_shadowed_by_earlier_param():
+    """f(u, a) where the caller's `a` collides with f's first param: the
+    second argument must see the CALLER's a, not the fresh binding."""
+    prog = compile_source("""
+      fun comp f(a: int32, b: int32) { x <- take; emit x + b }
+      let comp main = read[int32] >>>
+        { a <- take; u <- take; f(u, a) } >>> write[int32]
+    """)
+    res = run(prog.comp, list(np.array([10, 99, 7], np.int32)))
+    np.testing.assert_array_equal(res.out_array(), [17])
+
+
+def test_impure_let_evaluates_once_at_runtime(capsys):
+    prog = compile_source("""
+      fun noisy() : int32 { println "SIDE EFFECT"; return 5 }
+      let comp main = read[int32] >>>
+        { let k = noisy(); repeat { x <- take; emit x + k } }
+        >>> write[int32]
+    """)
+    assert capsys.readouterr().out.count("SIDE EFFECT") == 0
+    res = run(prog.comp, list(np.array([1, 2], np.int32)))
+    np.testing.assert_array_equal(res.out_array(), [6, 7])
+    assert capsys.readouterr().out.count("SIDE EFFECT") == 1
+
+
+def test_negative_index_rejected():
+    prog = compile_source("""
+      fun f(x: int32) : int32 {
+        var a : arr[4] int32 := {10, 20, 30, 40};
+        return a[0 - 1]
+      }
+      let comp main = read[int32] >>> map f >>> write[int32]
+    """)
+    with pytest.raises(ZiriaRuntimeError, match="out of bounds"):
+        run(prog.comp, [np.int32(0)])

@@ -1,0 +1,83 @@
+"""Native C buffer runtime vs the pure-python path (buf.c — the
+reference's buf_*.c/bit.c equivalents, SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.runtime import native_lib
+from ziria_tpu.runtime.buffers import (StreamSpec, read_stream,
+                                       write_stream, _format_dbg,
+                                       _parse_dbg, _parse_bin, _format_bin)
+
+pytestmark = pytest.mark.skipif(native_lib.load() is None,
+                                reason="no native toolchain")
+
+
+def test_bit_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    for n in (1, 7, 8, 9, 1023, 4096):
+        bits = rng.integers(0, 2, n).astype(np.uint8)
+        packed = native_lib.pack_bits_native(bits)
+        assert packed == np.packbits(bits, bitorder="little").tobytes()
+        back = native_lib.unpack_bits_native(packed)
+        np.testing.assert_array_equal(back[:n], bits)
+        assert not back[n:].any()
+
+
+def test_dbg_bits_native_matches_python():
+    text = "0110 , 1\n101x01"
+    got = native_lib.parse_dbg_bits_native(text)
+    want = np.array([int(c) for c in text if c in "01"], np.uint8)
+    np.testing.assert_array_equal(got, want)
+    assert native_lib.format_dbg_bits_native(want) == "".join(
+        map(str, want))
+
+
+def test_dbg_ints_native_matches_python():
+    vals = np.array([0, -1, 2147483647, -2147483648, 42, 0x1F], np.int64)
+    text = ",".join(str(v) for v in vals[:-1]) + ",0x1F"
+    got = native_lib.parse_dbg_ints_native(text)
+    np.testing.assert_array_equal(got, vals)
+    assert native_lib.format_dbg_ints_native(vals) == \
+        ",".join(str(int(v)) for v in vals)
+
+
+def test_dbg_ints_malformed():
+    with pytest.raises(ValueError, match="malformed"):
+        native_lib.parse_dbg_ints_native("12,ab")
+
+
+@pytest.mark.parametrize("ty", ["bit", "int8", "int16", "int32",
+                                "complex16", "complex32"])
+@pytest.mark.parametrize("mode", ["dbg", "bin"])
+def test_stream_roundtrip_all_types(tmp_path, ty, mode):
+    rng = np.random.default_rng(3)
+    if ty == "bit":
+        arr = rng.integers(0, 2, 64).astype(np.uint8)
+    elif ty in ("complex16", "complex32"):
+        dt = np.int16 if ty == "complex16" else np.int32
+        arr = rng.integers(-1000, 1000, (32, 2)).astype(dt)
+    else:
+        info = np.iinfo(np.dtype(ty))
+        arr = rng.integers(info.min, info.max, 64).astype(ty)
+    p = tmp_path / f"s.{mode}"
+    write_stream(StreamSpec(ty=ty, path=str(p), mode=mode), arr)
+    back = read_stream(StreamSpec(ty=ty, path=str(p), mode=mode))
+    if ty == "bit" and mode == "bin":
+        back = back[:arr.size]
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_parse_paths_agree_with_fallback(monkeypatch):
+    """The native and numpy paths must be bit-identical."""
+    rng = np.random.default_rng(5)
+    vals = rng.integers(-30000, 30000, 500).astype(np.int16)
+    text = _format_dbg(vals, "int16")
+    native = _parse_dbg(text, "int16")
+
+    monkeypatch.setattr(native_lib, "parse_dbg_ints_native",
+                        lambda *_: None)
+    monkeypatch.setattr(native_lib, "parse_dbg_bits_native",
+                        lambda *_: None)
+    fallback = _parse_dbg(text, "int16")
+    np.testing.assert_array_equal(native, fallback)

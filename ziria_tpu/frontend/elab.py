@@ -257,6 +257,10 @@ class Elaborator:
         return self.ctx.static_eval(e, scope)
 
     def try_st_eval(self, e: A.Expr, ee: ElabEnv) -> Tuple[bool, Any]:
+        # never speculatively evaluate impure expressions (a user fun can
+        # print/error — compile-time evaluation would fire the effect)
+        if not _is_pure(e):
+            return False, None
         fv = free_vars(e)
         # a runtime-bound name shadows any static global of the same name:
         # folding through it would silently substitute the global's value
@@ -562,8 +566,20 @@ class Elaborator:
                     runtime_binds.append(
                         (p.name, self.closure(a, ee, cast_ty=p.ty)))
             body = self.elab_comp(d.body, ee2)
-            for pname, cl in reversed(runtime_binds):
-                body = ir.Bind(ir.Return(cl), pname, body)
+            # evaluate ALL argument closures before binding ANY parameter:
+            # binding param i before evaluating argument j>i would let the
+            # fresh binding shadow a caller variable of the same name.
+            # Stage through unique temps, then alias params to them.
+            temps = []
+            for pname, cl in runtime_binds:
+                self._tmp = getattr(self, "_tmp", 0) + 1
+                temps.append((f"__arg{self._tmp}_{pname}", pname, cl))
+            for tname, pname, _ in reversed(temps):
+                def alias(env, _t=tname):
+                    return env.lookup(_t)
+                body = ir.Bind(ir.Return(alias), pname, body)
+            for tname, _, cl in reversed(temps):
+                body = ir.Bind(ir.Return(cl), tname, body)
             return body
         finally:
             self._inlining.pop()

@@ -404,7 +404,10 @@ def eval_expr(e: A.Expr, scope: Scope, ctx: Ctx) -> Any:
         i = eval_expr(e.i, scope, ctx)
         if isinstance(arr, dict):
             raise _rt_err(e.loc, "cannot index a struct")
-        return arr[i] if is_static(i) else jnp.asarray(arr)[i]
+        if is_static(i):
+            _check_index(int(i), arr, e.loc)
+            return arr[int(i)]
+        return jnp.asarray(arr)[i]
     if isinstance(e, A.ESlice):
         arr = jnp.asarray(eval_expr(e.arr, scope, ctx))
         i = eval_expr(e.i, scope, ctx)
@@ -482,6 +485,16 @@ def _eval_call(e: A.ECall, scope: Scope, ctx: Ctx) -> Any:
         ctx.on_print(msg + ("\n" if name == "println" else ""))
         return None
     raise _rt_err(e.loc, f"unknown function {name!r}")
+
+
+def _check_index(i: int, arr: Any, loc) -> None:
+    """C-like bounds discipline: no Python negative wraparound."""
+    n = np.shape(arr)[0] if np.shape(arr) else None
+    if n is None:
+        raise _rt_err(loc, "cannot index a scalar")
+    if i < 0 or i >= n:
+        raise _rt_err(loc, f"index {i} out of bounds for array of "
+                           f"length {n}")
 
 
 def _fmt_value(v: Any) -> str:
@@ -630,6 +643,8 @@ def _assign_lval(lval: A.Expr, v: Any, scope: Scope, ctx: Ctx) -> None:
     if isinstance(lval, A.EIdx):
         old = eval_expr(lval.arr, scope, ctx)
         i = eval_expr(lval.i, scope, ctx)
+        if is_static(i):
+            _check_index(int(i), old, lval.loc)
         new = jnp.asarray(old).at[i].set(
             jnp.asarray(v, dtype=jnp.asarray(old).dtype))
         _assign_lval(lval.arr, new, scope, ctx)

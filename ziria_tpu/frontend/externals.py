@@ -91,6 +91,10 @@ def register_external(name: str, fn: Callable) -> None:
 def resolve_ext(name: str) -> Callable:
     fn = EXTERNALS.get(name)
     if fn is None:
+        # the fixed-point math library self-registers on import
+        import ziria_tpu.ops.ext_math  # noqa: F401
+        fn = EXTERNALS.get(name)
+    if fn is None:
         known = ", ".join(sorted(EXTERNALS))
         raise KeyError(
             f"ext fun {name!r} is not in the externals registry "

@@ -1,0 +1,163 @@
+"""Fixed-point math library: the reference's ext_math.c equivalents.
+
+The reference binds C `ext` functions for fixed-point trig/math
+(`csrc/ext_math.c` + `sora_ext_lib.c`, SURVEY.md §2.2): sine/cosine/
+atan2 over int16 angles, sqrt, log — LUT-backed where the bit-width is
+small, because the SDR pipelines do phase tracking and CFO correction
+in int16 Q-format, not doubles. TPU-first re-design:
+
+- angles are int16 in the **Q15 turn format**: -32768..32767 maps to
+  -π..π (wrap-around ≡ phase wrap, so angle arithmetic is plain int16
+  add/sub — the reason SDR code loves this format);
+- `sin_int16`/`cos_int16` return Q14 (-16384..16384 ≡ -1..1), computed
+  by a 1024-entry quarter-resolution LUT gather (VMEM-resident, the
+  TPU analogue of SORA's table) — gathers vectorize over any shape;
+- `atan2_int16` returns the Q15 turn angle from int16 (y, x) — used by
+  pilot phase tracking; implemented in f32 on the VPU then quantized,
+  bit-deviation bounded by the Q15 step;
+- `usqrt`/`ulog2` integer helpers mirror the reference's integer math.
+
+All functions are jnp-traceable (usable inside jit/scan/vmap) and are
+registered as frontend externals, so `.zir` sources can declare e.g.
+`ext fun sin_int16(x: int16) : int16`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_Q15_PI = 32768.0           # int16 angle units per π radians
+_Q14_ONE = 16384.0          # unit amplitude
+
+_SIN_BITS = 10              # 1024-entry LUT: step = 2π/65536*64 rad
+_SIN_N = 1 << _SIN_BITS
+
+# module-level host table; gathered on device (constant-folded into the
+# executable by XLA on first use)
+_SIN_LUT = np.round(
+    _Q14_ONE * np.sin(2.0 * np.pi * np.arange(_SIN_N) / _SIN_N)
+).astype(np.int16)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# --------------------------------------------------------------------------
+# Q15 angle helpers
+# --------------------------------------------------------------------------
+
+
+def rad_to_q15(theta) -> np.ndarray:
+    """Radians → int16 turn angle (host-side helper for tests/config)."""
+    t = np.asarray(theta, np.float64) / (2 * np.pi)
+    t = t - np.round(t)
+    return np.round(t * 65536.0).astype(np.int64).astype(np.int16)
+
+
+def q15_to_rad(a):
+    return np.asarray(a, np.float64) * (np.pi / _Q15_PI)
+
+
+# --------------------------------------------------------------------------
+# sine / cosine (LUT gather)
+# --------------------------------------------------------------------------
+
+
+def sin_int16(a):
+    """Q14 sine of a Q15 turn angle (int16 → int16).
+
+    LUT index = top 10 bits of the 16-bit angle; max error vs the real
+    sine is one LUT step (~0.4% of full scale), same order as the
+    reference's table-based fixed-point sine.
+    """
+    jnp = _jnp()
+    a = jnp.asarray(a, jnp.int16)
+    idx = (a.astype(jnp.uint16) >> (16 - _SIN_BITS)).astype(jnp.int32)
+    return jnp.asarray(_SIN_LUT)[idx]
+
+
+def cos_int16(a):
+    jnp = _jnp()
+    a = jnp.asarray(a, jnp.int16)
+    # cos x = sin(x + π/2); +16384 wraps naturally in int16
+    return sin_int16(a + jnp.int16(16384))
+
+
+def sincos_int16(a):
+    return sin_int16(a), cos_int16(a)
+
+
+# --------------------------------------------------------------------------
+# atan2 (f32 compute, Q15 quantized result)
+# --------------------------------------------------------------------------
+
+
+def atan2_int16(y, x):
+    """Q15 turn angle of (y, x) — int16 in, int16 out."""
+    jnp = _jnp()
+    th = jnp.arctan2(jnp.asarray(y, jnp.float32),
+                     jnp.asarray(x, jnp.float32))
+    q = jnp.round(th * (_Q15_PI / np.float32(np.pi)))
+    # +π maps to -32768 (same angle mod 2π), keeping int16 range exact
+    q = jnp.where(q >= 32768.0, -32768.0, q)
+    return q.astype(jnp.int16)
+
+
+# --------------------------------------------------------------------------
+# integer sqrt / log2 (reference integer-math helpers)
+# --------------------------------------------------------------------------
+
+
+def usqrt(x):
+    """floor(sqrt(x)) for non-negative int32, exact.
+
+    f32 sqrt has enough mantissa only below 2^24, so refine the rounded
+    estimate by ±1 with integer compares — branch-free, VPU-friendly.
+    """
+    jnp = _jnp()
+    x = jnp.asarray(x, jnp.int32)
+    r = jnp.sqrt(x.astype(jnp.float32)).astype(jnp.int32)
+    r = jnp.maximum(r, 0)
+    # correct both directions of f32 rounding with overflow-free integer
+    # compares: r*r > x  ⟺  r > x//r  (r^2 would overflow int32 at the
+    # top of the range, x//r never does)
+    from jax import lax
+    rp = r + 1
+    r = jnp.where(rp <= lax.div(x, jnp.maximum(rp, 1)), rp, r)
+    r = jnp.where(r > lax.div(x, jnp.maximum(r, 1)), r - 1, r)
+    return r
+
+
+def ulog2(x):
+    """floor(log2(x)) for positive int32 (0 for x <= 1)."""
+    jnp = _jnp()
+    x = jnp.asarray(x, jnp.int32)
+    n = jnp.zeros_like(x)
+    v = x
+    for shift in (16, 8, 4, 2, 1):       # unrolled binary search
+        big = v >= (1 << shift)
+        n = jnp.where(big, n + shift, n)
+        v = jnp.where(big, v >> shift, v)
+    return n
+
+
+# --------------------------------------------------------------------------
+# frontend externals registration
+# --------------------------------------------------------------------------
+
+
+def register() -> None:
+    from ziria_tpu.frontend.externals import register_external
+    for name, fn in (
+        ("sin_int16", sin_int16),
+        ("cos_int16", cos_int16),
+        ("atan2_int16", atan2_int16),
+        ("usqrt", usqrt),
+        ("ulog2", ulog2),
+    ):
+        register_external(name, fn)
+
+
+register()

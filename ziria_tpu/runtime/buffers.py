@@ -58,11 +58,26 @@ def item_shape(ty: str) -> tuple:
 
 
 def _parse_dbg(text: str, ty: str) -> np.ndarray:
+    from ziria_tpu.runtime import native_lib
     if ty == "bit":
+        bits = native_lib.parse_dbg_bits_native(text)
+        if bits is not None:
+            return bits
         vals = [c for c in text if c in "01"]
         return np.array([int(c) for c in vals], np.uint8)
-    toks = text.replace(",", " ").split()
     base = _SCALAR_DTYPES.get(ty) or _PAIR_DTYPES[ty]
+    if np.issubdtype(base, np.integer):
+        flat64 = native_lib.parse_dbg_ints_native(text)
+        if flat64 is not None:
+            flat = flat64.astype(base)
+            if ty in _PAIR_DTYPES:
+                if flat.size % 2:
+                    raise ValueError(
+                        f"dbg {ty} stream has odd value count {flat.size} "
+                        f"(items are re,im pairs)")
+                return flat.reshape(-1, 2)
+            return flat
+    toks = text.replace(",", " ").split()
     if np.issubdtype(base, np.floating):
         flat = np.array([float(t) for t in toks], base)
     else:
@@ -77,7 +92,11 @@ def _parse_dbg(text: str, ty: str) -> np.ndarray:
 
 
 def _format_dbg(arr: np.ndarray, ty: str) -> str:
+    from ziria_tpu.runtime import native_lib
     if ty == "bit":
+        s = native_lib.format_dbg_bits_native(arr.ravel())
+        if s is not None:
+            return s
         return "".join("1" if v else "0" for v in arr.ravel())
     flat = arr.ravel()
     if ty in ("float32", "float64"):
@@ -85,6 +104,11 @@ def _format_dbg(arr: np.ndarray, ty: str) -> str:
         prec = ".9g" if flat.dtype == np.float32 else ".17g"
         return ",".join(f"{float(v):{prec}}" for v in flat)
     # integer item type: round float pipeline outputs, don't truncate
+    if np.issubdtype(flat.dtype, np.floating):
+        flat = np.rint(flat)
+    s = native_lib.format_dbg_ints_native(flat.astype(np.int64))
+    if s is not None:
+        return s
     return ",".join(str(int(round(float(v)))) for v in flat)
 
 
@@ -95,6 +119,10 @@ def _format_dbg(arr: np.ndarray, ty: str) -> str:
 
 def _parse_bin(data: bytes, ty: str) -> np.ndarray:
     if ty == "bit":
+        from ziria_tpu.runtime import native_lib
+        bits = native_lib.unpack_bits_native(data)
+        if bits is not None:
+            return bits
         packed = np.frombuffer(data, np.uint8)
         return np.unpackbits(packed, bitorder="little")
     base = _SCALAR_DTYPES.get(ty) or _PAIR_DTYPES[ty]
@@ -107,7 +135,11 @@ def _parse_bin(data: bytes, ty: str) -> np.ndarray:
 
 def _format_bin(arr: np.ndarray, ty: str) -> bytes:
     if ty == "bit":
+        from ziria_tpu.runtime import native_lib
         bits = np.asarray(arr, np.uint8).ravel()
+        packed = native_lib.pack_bits_native(bits)
+        if packed is not None:
+            return packed
         return np.packbits(bits, bitorder="little").tobytes()
     base = _SCALAR_DTYPES.get(ty) or _PAIR_DTYPES[ty]
     a = np.asarray(arr)

@@ -1,0 +1,118 @@
+/* Typed stream buffer helpers: the reference's buf_*.c / bit.c roles.
+ *
+ * The reference runtime reads and writes typed streams through C
+ * buffer modules (csrc/buf_bit.c, buf_numerics{8,16,32}.c, bit.c —
+ * SURVEY.md §2.2): text "dbg" mode and raw "bin" mode, with bit
+ * streams packed 8-per-byte. Here the same hot paths — dbg text
+ * parse/format and bit pack/unpack — are native C behind ctypes
+ * (ziria_tpu/runtime/native_lib.py), used by runtime/buffers.py as the
+ * fast path with a numpy fallback. The TPU compute path never touches
+ * these; they are host I/O, exactly like the reference's.
+ *
+ * Conventions (must match buffers.py):
+ *   - bit dbg: one '0'/'1' character per item, other bytes ignored;
+ *   - bit bin: LSB-first packing within each byte, zero-padded tail;
+ *   - int dbg: items separated by commas and/or whitespace.
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+/* ---------------------------------------------------------------- bits */
+
+void ziria_pack_bits(const uint8_t *bits, int64_t n, uint8_t *out) {
+    int64_t nb = (n + 7) / 8;
+    memset(out, 0, (size_t)nb);
+    for (int64_t i = 0; i < n; i++)
+        out[i >> 3] |= (uint8_t)((bits[i] & 1u) << (i & 7));
+}
+
+void ziria_unpack_bits(const uint8_t *bytes, int64_t n_bytes, uint8_t *out) {
+    for (int64_t i = 0; i < n_bytes; i++) {
+        uint8_t b = bytes[i];
+        uint8_t *o = out + i * 8;
+        for (int k = 0; k < 8; k++)
+            o[k] = (b >> k) & 1u;
+    }
+}
+
+/* dbg text -> bit items; returns count written (<= text_len). */
+int64_t ziria_parse_dbg_bits(const char *text, int64_t text_len,
+                             uint8_t *out) {
+    int64_t n = 0;
+    for (int64_t i = 0; i < text_len; i++) {
+        char c = text[i];
+        if (c == '0' || c == '1')
+            out[n++] = (uint8_t)(c - '0');
+    }
+    return n;
+}
+
+void ziria_format_dbg_bits(const uint8_t *bits, int64_t n, char *out) {
+    for (int64_t i = 0; i < n; i++)
+        out[i] = bits[i] ? '1' : '0';
+    out[n] = '\0';
+}
+
+/* ---------------------------------------------------------------- ints */
+
+/* dbg text -> int64 items (commas/whitespace separators, optional sign,
+ * 0x hex). Returns count, or -1 on malformed input. Caller sizes `out`
+ * for at most (text_len + 1) / 2 + 1 items. */
+int64_t ziria_parse_dbg_ints(const char *text, int64_t text_len,
+                             int64_t *out) {
+    int64_t n = 0, i = 0;
+    while (i < text_len) {
+        char c = text[i];
+        if (c == ',' || c == ' ' || c == '\t' || c == '\n' || c == '\r') {
+            i++;
+            continue;
+        }
+        int neg = 0;
+        if (c == '-' || c == '+') {
+            neg = (c == '-');
+            i++;
+            if (i >= text_len) return -1;
+            c = text[i];
+        }
+        if (c < '0' || c > '9') return -1;
+        int64_t v = 0;
+        if (c == '0' && i + 1 < text_len &&
+            (text[i + 1] == 'x' || text[i + 1] == 'X')) {
+            i += 2;
+            int digits = 0;
+            while (i < text_len) {
+                char d = text[i];
+                int hv;
+                if (d >= '0' && d <= '9') hv = d - '0';
+                else if (d >= 'a' && d <= 'f') hv = d - 'a' + 10;
+                else if (d >= 'A' && d <= 'F') hv = d - 'A' + 10;
+                else break;
+                v = v * 16 + hv;
+                digits++;
+                i++;
+            }
+            if (!digits) return -1;
+        } else {
+            while (i < text_len && text[i] >= '0' && text[i] <= '9') {
+                v = v * 10 + (text[i] - '0');
+                i++;
+            }
+        }
+        out[n++] = neg ? -v : v;
+    }
+    return n;
+}
+
+/* int64 items -> dbg text (comma separated). Returns chars written
+ * (excluding NUL). Caller sizes `out` for at least n * 21 + 1 bytes. */
+int64_t ziria_format_dbg_ints(const int64_t *vals, int64_t n, char *out) {
+    char *p = out;
+    for (int64_t i = 0; i < n; i++) {
+        if (i) *p++ = ',';
+        p += sprintf(p, "%lld", (long long)vals[i]);
+    }
+    *p = '\0';
+    return (int64_t)(p - out);
+}
