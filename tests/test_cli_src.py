@@ -104,3 +104,26 @@ def test_interleaver_cli_flag_matrix(tmp_path):
     perm = 3 * (k % 16) + k // 16
     src_blk = xs[:48]
     np.testing.assert_array_equal(blk[perm], src_blk)
+
+
+@pytest.mark.parametrize("backend", ["interp", "jit"])
+def test_wifi_tx_bpsk_matches_ops_chain(tmp_path, backend):
+    """The surface-syntax TX bit pipeline == the ops/ oracle chain
+    (scramble ^ seq -> conv_encode -> interleave at N_CBPS=48)."""
+    from ziria_tpu.ops.coding import np_conv_encode_ref
+    from ziria_tpu.ops.interleave import interleave
+    from ziria_tpu.ops.scramble import np_lfsr_sequence_127
+
+    src = os.path.join(EXAMPLES, "wifi_tx_bpsk.zir")
+    rng = np.random.default_rng(7)
+    n_bits = 24 * 8            # -> 48*8 coded bits, 8 interleaver blocks
+    xs = rng.integers(0, 2, n_bits).astype(np.uint8)
+    out = _run_cli(src, xs, "bit", tmp_path, "dbg", backend)
+
+    seed = np.array([1, 0, 1, 1, 1, 0, 1], np.uint8)
+    scr = xs ^ np.resize(np_lfsr_sequence_127(seed), n_bits)
+    coded = np_conv_encode_ref(scr)
+    want = np.concatenate([
+        np.asarray(interleave(coded[k:k + 48], 48, 1))
+        for k in range(0, coded.size, 48)])
+    np.testing.assert_array_equal(out.astype(np.uint8), want)

@@ -1,0 +1,139 @@
+"""Transceiver/MAC-lite tests: closed TX↔RX loop with stop-and-wait ARQ
+(phy/wifi/transceiver.py — the reference's transceiver/ + mac/ role,
+SURVEY.md §2.3)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ziria_tpu.phy import channel as ch
+from ziria_tpu.phy.wifi import transceiver as trx
+from ziria_tpu.phy.wifi.transceiver import (MacFrame, Station, TYPE_ACK,
+                                            TYPE_DATA, mac_frame_psdu,
+                                            run_link)
+
+
+def test_mac_frame_roundtrip():
+    psdu = mac_frame_psdu(TYPE_DATA, 7, dst=2, src=1, payload=b"hello")
+    fr = MacFrame.parse(psdu)
+    assert fr is not None
+    assert (fr.ftype, fr.seq, fr.dst, fr.src, fr.payload) == \
+        (TYPE_DATA, 7, 2, 1, b"hello")
+
+
+def test_mac_frame_crc_reject():
+    psdu = mac_frame_psdu(TYPE_ACK, 3, dst=2, src=1)
+    bad = psdu.copy()
+    bad[1] ^= 0x40
+    assert MacFrame.parse(bad) is None
+
+
+def test_perfect_link_delivers_and_acks():
+    a = Station(addr=1, rate_mbps=24)
+    b = Station(addr=2)
+    payloads = [b"frame-one", b"frame-two longer payload", b"x"]
+    run_link(a, b, payloads)
+    assert [p for _, p in b.delivered] == payloads
+    assert all(src == 1 for src, _ in b.delivered)
+    assert a.acked == [0, 1, 2] and a.failed == []
+    assert a.counters["retries"] == 0
+    assert b.counters["tx_ack"] == 3 and a.counters["rx_ack"] == 3
+
+
+def test_lost_data_frame_retransmits():
+    """Channel kills the first copy of each DATA frame; ARQ recovers."""
+    a = Station(addr=1, rate_mbps=12)
+    b = Station(addr=2)
+    seen = []
+
+    def lossy(samples, k):
+        seen.append(k)
+        # transmissions alternate DATA/ACK on a clean link; kill the
+        # very first transmission only
+        if k == 0:
+            return np.zeros_like(samples)
+        return samples
+
+    run_link(a, b, [b"payload"], channel=lossy)
+    assert [p for _, p in b.delivered] == [b"payload"]
+    assert a.counters["retries"] == 1
+    assert a.acked == [0] and a.failed == []
+    assert seen == [0, 1, 2]   # DATA (lost), DATA (retry), ACK
+
+
+def test_lost_ack_dedups_on_retransmit():
+    """ACK lost: sender retransmits, receiver re-ACKs but must not
+    deliver the payload twice."""
+    a = Station(addr=1, rate_mbps=12)
+    b = Station(addr=2)
+
+    def drop_first_ack(samples, k):
+        if k == 1:     # k=0 DATA, k=1 the first ACK
+            return np.zeros_like(samples)
+        return samples
+
+    run_link(a, b, [b"only-once"], channel=drop_first_ack)
+    assert [p for _, p in b.delivered] == [b"only-once"]
+    assert b.counters["dups"] == 1 and b.counters["rx_data"] == 2
+    assert a.acked == [0]
+
+
+def test_retry_limit_gives_up():
+    a = Station(addr=1, rate_mbps=12, max_tries=2)
+    b = Station(addr=2)
+
+    def dead(samples, k):
+        return np.zeros_like(samples)
+
+    run_link(a, b, [b"void"], channel=dead)
+    assert b.delivered == []
+    assert a.failed == [0] and a.acked == []
+    assert a.counters["drops"] == 1
+    # a later frame over a good channel still goes through
+    run_link(a, b, [b"after"], channel=trx.perfect_channel)
+    assert [p for _, p in b.delivered] == [b"after"]
+
+
+def test_noisy_channel_link():
+    """AWGN + idle-air padding + small CFO: the full sync path in the
+    loop, both directions."""
+    a = Station(addr=1, rate_mbps=24)
+    b = Station(addr=2)
+    keys = iter(jax.random.split(jax.random.PRNGKey(0), 64))
+
+    def noisy(samples, k):
+        x = ch.delay(next(keys), samples, n_before=180, n_after=64,
+                     noise_db=-28.0)
+        x = ch.apply_cfo(x, 0.0012)
+        return np.asarray(ch.awgn(next(keys), x, snr_db=18.0))
+
+    payloads = [b"noisy link frame", b"second"]
+    run_link(a, b, payloads, channel=noisy)
+    assert [p for _, p in b.delivered] == payloads
+    assert a.failed == []
+
+
+def test_long_frame_timer_starts_after_transmit():
+    """A frame longer than ACK_TIMEOUT samples must not expire during
+    its own transmission (timer anchored at end of emit)."""
+    a = Station(addr=1, rate_mbps=6)      # ~1KB at 6 Mbps >> ACK_TIMEOUT
+    payload = bytes(1000)
+    a.send(payload, dst=2)
+    assert a._pending is not None
+    assert a._pending.deadline > a.now    # not already expired
+    assert a.poll() is None               # no spurious retransmit
+
+
+def test_run_link_step_exhaustion_fails_cleanly():
+    """max_steps exhausted with the frame in flight: frame is failed,
+    next send() is not poisoned."""
+    a = Station(addr=1, rate_mbps=12, max_tries=100)
+    b = Station(addr=2)
+
+    def dead(samples, k):
+        return np.zeros_like(samples)
+
+    run_link(a, b, [b"lost", b"also-lost"], channel=dead, max_steps=3)
+    assert a.failed == [0, 1]
+    assert a.counters["drops"] == 2
