@@ -44,15 +44,46 @@ def run_jit(comp: ir.Comp, inputs, width: Optional[int] = None,
     reference's `--fold` flag; output is invariant (tested) but folded
     programs can lower where raw ones can't (const branches) and fuse to
     fewer stages."""
+    ys, _ = run_jit_carry(comp, inputs, width=width,
+                          target_items=target_items, optimize=optimize)
+    return ys
+
+
+def run_jit_carry(comp: ir.Comp, inputs, carry=None,
+                  width: Optional[int] = None, target_items: int = 8192,
+                  optimize: bool = False):
+    """Like run_jit, but stream-resumable: returns ``(outputs, carry)``
+    where carry is ``{"stages": <per-stage state pytree>, "leftover":
+    <input items not yet forming a full steady-state iteration>}``.
+    Feeding a stream in pieces with the carry threaded through produces
+    exactly the one-shot output for ANY chunk boundaries — sub-iteration
+    remainders ride along in "leftover" instead of being dropped (the
+    vectorized-EOF drop applies only to the true end of stream). This is
+    the basis of the runtime's checkpoint/resume (runtime/state.py). The
+    carry's structure is width-independent, so chunk sizes may differ
+    call to call."""
     if optimize:
         from ziria_tpu.core.opt import fold
         comp = fold(comp)
     inputs = np.asarray(inputs)
+    stage_carry = None
+    if carry is not None:
+        if isinstance(carry, dict):
+            stage_carry = carry.get("stages")
+            leftover = carry.get("leftover")
+            if leftover is not None and np.size(leftover):
+                inputs = np.concatenate(
+                    [np.asarray(leftover, inputs.dtype), inputs], axis=0)
+        else:                       # bare stage pytree (no leftover)
+            stage_carry = carry
     big = lower(comp, width=width, target_items=target_items)
     n_iters = inputs.shape[0] // big.ss.take
     outs = []
 
-    carry = big.init_carry
+    if stage_carry is None:
+        carry = big.init_carry
+    else:
+        carry = jax.tree.map(jnp.asarray, stage_carry)
     n_bulk = n_iters // big.width
     if n_bulk:
         scan_fn = _jit_scan(big)
@@ -75,12 +106,15 @@ def run_jit(comp: ir.Comp, inputs, width: Optional[int] = None,
         ys = np.asarray(ys)
         outs.append(ys.reshape((rem_iters * small.emit,) + ys.shape[2:]))
 
+    leftover = inputs[n_iters * big.ss.take:]
+    carry_out = {"stages": carry, "leftover": np.asarray(leftover)}
     if not outs:
-        # no full steady-state iteration: no output (vectorized-EOF rule);
-        # output item shape is unknown without running, so report 0 items
-        # with the input's item shape as the best available annotation
-        return np.empty((0,) + inputs.shape[1:])
-    return np.concatenate(outs, axis=0)
+        # no full steady-state iteration: no output yet; the items wait
+        # in leftover (they are only dropped at true end-of-stream — the
+        # vectorized-EOF rule). Item shape of the output is unknown
+        # without running, so report 0 items with the input's item shape
+        return np.empty((0,) + inputs.shape[1:]), carry_out
+    return np.concatenate(outs, axis=0), carry_out
 
 
 def run_vect(comp: ir.Comp, inputs, plan=None, optimize: bool = False,

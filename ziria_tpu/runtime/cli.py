@@ -26,6 +26,7 @@ Example:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from typing import Callable, Dict, Optional
@@ -153,7 +154,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--autolut", action="store_true")
     p.add_argument("--ddump-fold", action="store_true",
                    help="dump the IR after folding")
+    p.add_argument("--ddump-vect", action="store_true",
+                   help="dump the vectorizer's scored candidate table")
+    p.add_argument("--stats", action="store_true",
+                   help="print the fused plan: per-stage firing counts, "
+                        "rates, width (jit backend)")
+    p.add_argument("--state-in",
+                   help="resume stream state from this checkpoint "
+                        "(runtime/state.py; jit backend)")
+    p.add_argument("--state-out",
+                   help="write final stream state to this checkpoint")
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--platform", default=None,
+                   help="pin the JAX platform (e.g. cpu, tpu) before "
+                        "backend init; also via ZIRIA_PLATFORM env var")
     return p
 
 
@@ -172,8 +186,28 @@ def _resolve_prog(args):
     return PROGS[args.prog](), None, None
 
 
+def _apply_platform(name: Optional[str]) -> None:
+    """Pin the JAX platform BEFORE backend init. Needed because an
+    installed PJRT plugin can win over the JAX_PLATFORMS env var; the
+    flag (or ZIRIA_PLATFORM) goes through jax.config, which the plugin
+    cannot override. No-op once the backend is live."""
+    name = name or os.environ.get("ZIRIA_PLATFORM")
+    if not name:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", name)
+    except RuntimeError:
+        live = jax.default_backend()
+        if live != name:
+            print(f"warning: --platform={name} requested but the JAX "
+                  f"backend is already initialized ({live}); running "
+                  f"on {live}", file=sys.stderr)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    _apply_platform(args.platform)
     if args.list_progs:
         for name in sorted(PROGS):
             print(name)
@@ -193,6 +227,9 @@ def main(argv=None) -> int:
         comp = fold(comp)
     if args.ddump_fold:
         print(comp, file=sys.stderr)
+    if args.ddump_vect:
+        from ziria_tpu.core.vectorize import vectorize
+        print(vectorize(comp).dump(), file=sys.stderr)
 
     in_spec = StreamSpec(kind=args.input, ty=in_ty,
                          path=args.input_file_name,
@@ -205,12 +242,39 @@ def main(argv=None) -> int:
     xs = read_stream(in_spec)
     t0 = time.perf_counter()
     if args.backend == "interp":
+        if args.state_in or args.state_out:
+            raise SystemExit("--state-in/--state-out need --backend=jit "
+                             "(stream state is the jit carry pytree)")
         from ziria_tpu.interp.interp import run
         res = run(comp, list(xs))
         ys = np.asarray(res.out_array())
     else:
-        from ziria_tpu.backend.execute import run_jit
-        ys = np.asarray(run_jit(comp, xs, width=args.width))
+        from ziria_tpu.backend.execute import lower, run_jit_carry
+        low = None
+        if args.state_in or args.stats:
+            low = lower(comp, width=args.width)   # lower once, reuse
+        carry = None
+        if args.state_in:
+            from ziria_tpu.runtime.state import load_state
+            carry = load_state(args.state_in, like=low.init_carry)
+        ys, carry = run_jit_carry(comp, xs, carry=carry, width=args.width)
+        ys = np.asarray(ys)
+        if args.state_out:
+            from ziria_tpu.runtime.state import save_state
+            save_state(args.state_out, carry)
+        if args.stats:
+            # mirror the executor's split: full-width bulk steps plus a
+            # width-1 remainder pass over leftover full iterations
+            n_iters = xs.shape[0] // low.ss.take
+            n_bulk = n_iters // low.width
+            rem = n_iters - n_bulk * low.width
+            print(f"plan: width={low.width} take={low.take} "
+                  f"emit={low.emit} bulk_steps={n_bulk} "
+                  f"remainder_iters={rem}", file=sys.stderr)
+            for lbl, reps in zip(low.labels, low.ss.reps):
+                print(f"  stage {lbl:<28s} {reps:>6d} firings/iter "
+                      f"({reps * low.width} per bulk step)",
+                      file=sys.stderr)
     dt = time.perf_counter() - t0
 
     write_stream(out_spec, ys)

@@ -1,0 +1,70 @@
+"""Checkpoint/resume of pipeline stream state.
+
+The reference has no persistence — component state lives in the
+generated C global state struct for the life of the process
+(SURVEY.md §5). Here that state is an explicit value: the carry
+returned by ``backend.execute.run_jit_carry`` — a dict of the
+per-stage state pytree (``"stages"``) plus the input items that did
+not yet fill a steady-state iteration (``"leftover"``). Checkpointing
+is flatten + save:
+
+    ys1, carry = run_jit_carry(prog, first_half)
+    save_state("ckpt.npz", carry)
+    ...process restarts...
+    carry = load_state("ckpt.npz", like=lower(prog).init_carry)
+    ys2, carry = run_jit_carry(prog, second_half, carry=carry)
+
+`ys1 ++ ys2` equals the one-shot run for any split point (tested).
+The template (`like`) restores the stage pytree structure — obtained
+by lowering the same program, so a checkpoint is only loadable against
+the pipeline that wrote it; a structure, shape, or dtype mismatch is
+reported, not silently accepted.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def save_state(path: str, carry: Any) -> None:
+    """Serialize a run_jit_carry carry (or bare stage pytree) to .npz."""
+    if isinstance(carry, dict) and "stages" in carry:
+        stages = carry["stages"]
+        leftover = np.asarray(carry.get("leftover", np.empty(0)))
+    else:
+        stages, leftover = carry, np.empty(0)
+    leaves = jax.tree.leaves(stages)
+    arrs = {f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)}
+    np.savez(path, n_leaves=np.int64(len(leaves)), leftover=leftover,
+             **arrs)
+
+
+def load_state(path: str, like: Any) -> Any:
+    """Load a carry saved by save_state, using `like` (the pipeline's
+    ``lower(comp).init_carry``) as the stage-structure template."""
+    with np.load(path) as z:
+        n = int(z["n_leaves"])
+        leaves = [z[f"leaf{i}"] for i in range(n)]
+        leftover = z["leftover"] if "leftover" in z else np.empty(0)
+    template_leaves, treedef = jax.tree.flatten(like)
+    if len(template_leaves) != n:
+        raise ValueError(
+            f"checkpoint has {n} state leaves but the pipeline has "
+            f"{len(template_leaves)} — wrong program for this checkpoint")
+    for i, (a, b) in enumerate(zip(leaves, template_leaves)):
+        b = np.asarray(b)
+        if np.shape(a) != b.shape:
+            raise ValueError(
+                f"state leaf {i} shape {np.shape(a)} does not match the "
+                f"pipeline's {b.shape} — wrong program for this "
+                f"checkpoint")
+        if np.asarray(a).dtype != b.dtype:
+            raise ValueError(
+                f"state leaf {i} dtype {np.asarray(a).dtype} does not "
+                f"match the pipeline's {b.dtype} — wrong program for "
+                f"this checkpoint")
+    return {"stages": jax.tree.unflatten(treedef, leaves),
+            "leftover": leftover}
