@@ -127,3 +127,37 @@ def test_wifi_tx_bpsk_matches_ops_chain(tmp_path, backend):
         np.asarray(interleave(coded[k:k + 48], 48, 1))
         for k in range(0, coded.size, 48)])
     np.testing.assert_array_equal(out.astype(np.uint8), want)
+
+
+def test_packet_detect_zir_dynamic_control(tmp_path):
+    """The streaming STS detector: a while-loop computer terminating
+    with a value (interpreter backend — data-dependent control)."""
+    src = os.path.join(EXAMPLES, "packet_detect.zir")
+    rng = np.random.default_rng(11)
+    # 100 noise samples, then a periodic (period-16) STS-like burst
+    noise = rng.normal(0, 30, (100, 2))
+    sts16 = rng.normal(0, 300, (16, 2))
+    burst = np.tile(sts16, (10, 1))
+    xs = np.concatenate([noise, burst]).astype(np.int16)
+    out = _run_cli(src, xs, "complex16", tmp_path, "dbg", "interp")
+    # detection fires once the window is periodic: a little after the
+    # burst start + one 16-lag window fill
+    assert out.shape[0] == 1
+    assert 100 <= int(out[0]) <= 140, int(out[0])
+
+
+def test_lut_map_autolut_flag_matrix(tmp_path):
+    """--autolut must leave output unchanged (table == direct eval)."""
+    src = os.path.join(EXAMPLES, "lut_map.zir")
+    xs = np.arange(-128, 128, dtype=np.int8)
+    outs = {}
+    for backend in ("interp", "jit"):
+        for extra in ((), ("--autolut",)):
+            outs[(backend, extra)] = _run_cli(
+                src, xs, "int8", tmp_path, "dbg", backend, extra=extra)
+    base = outs[("interp", ())]
+    for k, v in outs.items():
+        np.testing.assert_array_equal(v, base, err_msg=str(k))
+    # spot-check the function: x=0b00001011 -> nibble 1011 reversed
+    # 1101=13, parity of high nibble 0000 is 0
+    assert base[128 + 0b1011] == 13

@@ -156,3 +156,60 @@ def test_checkpoint_dtype_mismatch_rejected(tmp_path):
                         name="floaty")
     with pytest.raises(ValueError, match="dtype"):
         load_state(ck, like=lower(other).init_carry)
+
+
+def test_resume_with_empty_and_list_chunks():
+    """Zero-length / plain-list chunks must not crash or corrupt the
+    leftover's dtype."""
+    prog = compile_source("""
+      ext fun v_fft(x: arr[64] complex16) : arr[64] complex16
+      let comp main = read[complex16] >>>
+        repeat { (s: arr[64] complex16) <- takes 64; emits v_fft(s) }
+        >>> write[complex16]
+    """).comp
+    xs = np.random.default_rng(5).integers(
+        -500, 500, (128, 2)).astype(np.int16)
+    want = run_jit(prog, xs)
+    ys1, carry = run_jit_carry(prog, xs[:100])
+    ys_mid, carry = run_jit_carry(prog, [], carry=carry)   # empty list
+    assert ys_mid.shape[0] == 0
+    assert carry["leftover"].dtype == np.int16             # unchanged
+    ys2, _ = run_jit_carry(prog, xs[100:], carry=carry)
+    np.testing.assert_allclose(
+        np.concatenate([ys1, ys2]).astype(np.float64),
+        want.astype(np.float64), atol=1.0)
+
+
+def test_malformed_carry_dict_rejected():
+    prog = _stateful_prog()
+    with pytest.raises(ValueError, match="stages"):
+        run_jit_carry(prog, np.zeros(8, np.uint8),
+                      carry={"stage": None, "leftover": np.empty(0)})
+
+
+def test_stats_counts_resumed_leftover(tmp_path, capsys):
+    """--stats on a resumed run counts the checkpoint's leftover items
+    toward the iteration total (uses the pre-run carry, not post-run)."""
+    from ziria_tpu.runtime.buffers import StreamSpec, write_stream
+    from ziria_tpu.runtime.cli import main as cli_main
+
+    src = os.path.join(os.path.dirname(__file__), "..", "examples",
+                       "fft64.zir")
+    rng = np.random.default_rng(8)
+    xs = rng.integers(-500, 500, (256, 2)).astype(np.int16)
+    ck = str(tmp_path / "ck.npz")
+
+    def run_cli(arr, tag, extra):
+        inf = tmp_path / f"i{tag}.dbg"
+        outf = tmp_path / f"o{tag}.dbg"
+        write_stream(StreamSpec(ty="complex16", path=str(inf)), arr)
+        rc = cli_main([f"--src={src}", "--input=file",
+                       f"--input-file-name={inf}", "--output=file",
+                       f"--output-file-name={outf}", "--stats", *extra])
+        assert rc == 0
+        return capsys.readouterr().err
+
+    run_cli(xs[:100], "a", [f"--state-out={ck}"])   # 1 iter + 36 left
+    err = run_cli(xs[100:], "b", [f"--state-in={ck}"])
+    # 36 + 156 = 192 items = 3 full iterations
+    assert "remainder_iters=3" in err, err.splitlines()[0]

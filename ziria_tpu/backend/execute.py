@@ -69,11 +69,27 @@ def run_jit_carry(comp: ir.Comp, inputs, carry=None,
     stage_carry = None
     if carry is not None:
         if isinstance(carry, dict):
-            stage_carry = carry.get("stages")
-            leftover = carry.get("leftover")
-            if leftover is not None and np.size(leftover):
-                inputs = np.concatenate(
-                    [np.asarray(leftover, inputs.dtype), inputs], axis=0)
+            if "stages" not in carry:
+                raise ValueError(
+                    "carry dict has no 'stages' key — not a "
+                    "run_jit_carry/load_state carry (malformed "
+                    "checkpoint?)")
+            stage_carry = carry["stages"]
+            lef = np.asarray(carry.get("leftover", np.empty(0)))
+            if lef.size:
+                # the leftover's dtype/item-shape are authoritative (it
+                # came from the same stream); never silently cast it
+                if inputs.shape[0] == 0:
+                    inputs = lef
+                elif inputs.shape[1:] != lef.shape[1:]:
+                    raise ValueError(
+                        f"resumed chunk item shape {inputs.shape[1:]} "
+                        f"does not match the checkpoint leftover's "
+                        f"{lef.shape[1:]}")
+                else:
+                    inputs = np.concatenate(
+                        [lef, inputs.astype(lef.dtype, copy=False)],
+                        axis=0)
         else:                       # bare stage pytree (no leftover)
             stage_carry = carry
     big = lower(comp, width=width, target_items=target_items)

@@ -252,11 +252,17 @@ def main(argv=None) -> int:
         from ziria_tpu.backend.execute import lower, run_jit_carry
         low = None
         if args.state_in or args.stats:
-            low = lower(comp, width=args.width)   # lower once, reuse
+            # one shared lowering for the state template and the stats
+            # report (run_jit_carry still lowers internally for
+            # execution — lower() is deterministic, so the plans agree)
+            low = lower(comp, width=args.width)
         carry = None
+        n_leftover_in = 0
         if args.state_in:
             from ziria_tpu.runtime.state import load_state
             carry = load_state(args.state_in, like=low.init_carry)
+            lef = np.asarray(carry.get("leftover", np.empty(0)))
+            n_leftover_in = lef.shape[0] if lef.ndim else 0
         ys, carry = run_jit_carry(comp, xs, carry=carry, width=args.width)
         ys = np.asarray(ys)
         if args.state_out:
@@ -264,8 +270,12 @@ def main(argv=None) -> int:
             save_state(args.state_out, carry)
         if args.stats:
             # mirror the executor's split: full-width bulk steps plus a
-            # width-1 remainder pass over leftover full iterations
-            n_iters = xs.shape[0] // low.ss.take
+            # width-1 remainder pass over leftover full iterations; a
+            # resumed checkpoint's leftover items count toward the total
+            # count the INPUT leftover (the post-run carry was just
+            # reassigned above; its leftover describes the next chunk)
+            n_avail = xs.shape[0] + n_leftover_in
+            n_iters = n_avail // low.ss.take
             n_bulk = n_iters // low.width
             rem = n_iters - n_bulk * low.width
             print(f"plan: width={low.width} take={low.take} "
