@@ -1,0 +1,74 @@
+"""Regenerate the checked-in golden files for examples/*.zir.
+
+The reference ships per-block tests as (program, .infile,
+.outfile.ground) triples compared by BlinkDiff (SURVEY.md §4). This
+script writes the same artifacts under examples/golden/: deterministic
+inputs, and ground-truth outputs produced by the **interpreter oracle**
+(never the jit backend — the golden test's whole point is that the
+compiled path must match the oracle).
+
+    python examples/make_golden.py          # writes examples/golden/
+
+Run the goldens with ``pytest tests/test_golden.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLD = os.path.join(HERE, "golden")
+
+# (example, input type, input builder, dbg|bin)
+def _bits(n, seed):
+    return np.random.default_rng(seed).integers(0, 2, n).astype(np.uint8)
+
+
+def _iq(n, seed):
+    return np.random.default_rng(seed).integers(
+        -600, 600, (n, 2)).astype(np.int16)
+
+
+CASES = [
+    ("scrambler", "bit", lambda: _bits(512, 100), "dbg"),
+    ("fir", "int32",
+     lambda: (2000 * np.sin(np.arange(256) / 7)).astype(np.int32), "dbg"),
+    ("fft64", "complex16", lambda: _iq(256, 101), "dbg"),
+    ("interleaver", "bit", lambda: _bits(480, 102), "dbg"),
+    ("wifi_tx_bpsk", "bit", lambda: _bits(384, 103), "bin"),
+    ("lut_map", "int8",
+     lambda: np.arange(-128, 128, dtype=np.int8), "dbg"),
+]
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ziria_tpu.frontend import compile_file
+    from ziria_tpu.interp.interp import run
+    from ziria_tpu.runtime.buffers import StreamSpec, write_stream
+
+    os.makedirs(GOLD, exist_ok=True)
+    for name, in_ty, make, mode in CASES:
+        src = os.path.join(HERE, f"{name}.zir")
+        prog = compile_file(src)
+        xs = make()
+        res = run(prog.comp, list(xs))
+        ys = res.out_array()
+        write_stream(StreamSpec(ty=in_ty, path=os.path.join(
+            GOLD, f"{name}.infile"), mode=mode), xs)
+        write_stream(StreamSpec(ty=prog.out_ty or in_ty, path=os.path.join(
+            GOLD, f"{name}.outfile.ground"), mode=mode), ys)
+        print(f"{name}: {xs.shape[0]} in -> {ys.shape[0]} out "
+              f"({mode}, {in_ty} -> {prog.out_ty})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Checked-in golden files: the reference's (.blk, .infile,
+.outfile.ground) discipline (SURVEY.md §4).
+
+Each example runs through the CLI jit backend against the committed
+input, and the output must match the committed ground truth (produced
+by the interpreter oracle via examples/make_golden.py) under the
+BlinkDiff-style comparator: exact for integer/bit streams, atol=1 for
+quantized complex."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ziria_tpu.frontend import compile_file
+from ziria_tpu.runtime.buffers import StreamSpec, read_stream
+from ziria_tpu.runtime.cli import main as cli_main
+from ziria_tpu.utils.diff import stream_diff
+
+HERE = os.path.dirname(__file__)
+EXAMPLES = os.path.abspath(os.path.join(HERE, "..", "examples"))
+GOLD = os.path.join(EXAMPLES, "golden")
+
+CASES = [
+    ("scrambler", "dbg", 0.0),
+    ("fir", "dbg", 0.0),
+    ("fft64", "dbg", 1.0),
+    ("interleaver", "dbg", 0.0),
+    ("wifi_tx_bpsk", "bin", 0.0),
+    ("lut_map", "dbg", 0.0),
+]
+
+
+@pytest.mark.parametrize("name,mode,atol", CASES)
+def test_golden(name, mode, atol, tmp_path):
+    src = os.path.join(EXAMPLES, f"{name}.zir")
+    infile = os.path.join(GOLD, f"{name}.infile")
+    ground = os.path.join(GOLD, f"{name}.outfile.ground")
+    assert os.path.exists(infile) and os.path.exists(ground), \
+        f"golden files missing for {name}; run examples/make_golden.py"
+
+    outf = tmp_path / f"{name}.out"
+    rc = cli_main([
+        f"--src={src}", "--input=file", f"--input-file-name={infile}",
+        f"--input-file-mode={mode}", "--output=file",
+        f"--output-file-name={outf}", f"--output-file-mode={mode}",
+        "--backend=jit",
+    ])
+    assert rc == 0
+
+    prog = compile_file(src)
+    got = read_stream(StreamSpec(ty=prog.out_ty, path=str(outf),
+                                 mode=mode))
+    want = read_stream(StreamSpec(ty=prog.out_ty, path=ground, mode=mode))
+    if atol:
+        rep = stream_diff(got.astype(np.float64), want.astype(np.float64),
+                          atol=atol, name=name)
+    else:
+        rep = stream_diff(got, want, name=name)
+    assert rep, rep.message

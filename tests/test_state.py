@@ -213,3 +213,24 @@ def test_stats_counts_resumed_leftover(tmp_path, capsys):
     err = run_cli(xs[100:], "b", [f"--state-in={ck}"])
     # 36 + 156 = 192 items = 3 full iterations
     assert "remainder_iters=3" in err, err.splitlines()[0]
+
+
+def test_resume_lossy_dtype_rejected_and_none_leftover_ok():
+    prog = compile_source("""
+      ext fun v_fft(x: arr[64] complex16) : arr[64] complex16
+      let comp main = read[complex16] >>>
+        repeat { (s: arr[64] complex16) <- takes 64; emits v_fft(s) }
+        >>> write[complex16]
+    """).comp
+    xs = np.random.default_rng(6).integers(
+        -500, 500, (128, 2)).astype(np.int16)
+    _, carry = run_jit_carry(prog, xs[:100])
+    # float chunk into an int16 stream: lossy kind change -> rejected
+    with pytest.raises(ValueError, match="dtype"):
+        run_jit_carry(prog, xs[100:].astype(np.float64) + 0.9,
+                      carry=carry)
+    # explicit leftover=None is treated as absent, not a 0-d array
+    ys, _ = run_jit_carry(prog, xs[:64],
+                          carry={"stages": carry["stages"],
+                                 "leftover": None})
+    assert ys.shape[0] == 64

@@ -51,7 +51,7 @@ def run_jit(comp: ir.Comp, inputs, width: Optional[int] = None,
 
 def run_jit_carry(comp: ir.Comp, inputs, carry=None,
                   width: Optional[int] = None, target_items: int = 8192,
-                  optimize: bool = False):
+                  optimize: bool = False, stats_out: Optional[dict] = None):
     """Like run_jit, but stream-resumable: returns ``(outputs, carry)``
     where carry is ``{"stages": <per-stage state pytree>, "leftover":
     <input items not yet forming a full steady-state iteration>}``.
@@ -75,10 +75,12 @@ def run_jit_carry(comp: ir.Comp, inputs, carry=None,
                     "run_jit_carry/load_state carry (malformed "
                     "checkpoint?)")
             stage_carry = carry["stages"]
-            lef = np.asarray(carry.get("leftover", np.empty(0)))
+            lef = carry.get("leftover")
+            lef = np.empty(0) if lef is None else np.asarray(lef)
             if lef.size:
                 # the leftover's dtype/item-shape are authoritative (it
-                # came from the same stream); never silently cast it
+                # came from the same stream); never silently cast in a
+                # lossy direction
                 if inputs.shape[0] == 0:
                     inputs = lef
                 elif inputs.shape[1:] != lef.shape[1:]:
@@ -87,6 +89,12 @@ def run_jit_carry(comp: ir.Comp, inputs, carry=None,
                         f"does not match the checkpoint leftover's "
                         f"{lef.shape[1:]}")
                 else:
+                    if inputs.dtype != lef.dtype and not np.can_cast(
+                            inputs.dtype, lef.dtype, casting="same_kind"):
+                        raise ValueError(
+                            f"resumed chunk dtype {inputs.dtype} is not "
+                            f"compatible with the checkpoint leftover's "
+                            f"{lef.dtype}")
                     inputs = np.concatenate(
                         [lef, inputs.astype(lef.dtype, copy=False)],
                         axis=0)
@@ -94,6 +102,15 @@ def run_jit_carry(comp: ir.Comp, inputs, carry=None,
             stage_carry = carry
     big = lower(comp, width=width, target_items=target_items)
     n_iters = inputs.shape[0] // big.ss.take
+    if stats_out is not None:
+        # the executed plan, from the executor's own arithmetic (the CLI
+        # --stats report prints this rather than re-deriving the split)
+        n_bulk0 = n_iters // big.width
+        stats_out.update(
+            width=big.width, take=big.take, emit=big.emit,
+            labels=big.labels, reps=big.ss.reps, n_iters=n_iters,
+            bulk_steps=n_bulk0, remainder_iters=n_iters - n_bulk0
+            * big.width)
     outs = []
 
     if stage_carry is None:

@@ -250,40 +250,29 @@ def main(argv=None) -> int:
         ys = np.asarray(res.out_array())
     else:
         from ziria_tpu.backend.execute import lower, run_jit_carry
-        low = None
-        if args.state_in or args.stats:
-            # one shared lowering for the state template and the stats
-            # report (run_jit_carry still lowers internally for
-            # execution — lower() is deterministic, so the plans agree)
-            low = lower(comp, width=args.width)
         carry = None
-        n_leftover_in = 0
         if args.state_in:
             from ziria_tpu.runtime.state import load_state
-            carry = load_state(args.state_in, like=low.init_carry)
-            lef = np.asarray(carry.get("leftover", np.empty(0)))
-            n_leftover_in = lef.shape[0] if lef.ndim else 0
-        ys, carry = run_jit_carry(comp, xs, carry=carry, width=args.width)
+            carry = load_state(args.state_in,
+                               like=lower(comp, width=args.width)
+                               .init_carry)
+        stats: Optional[dict] = {} if args.stats else None
+        ys, carry = run_jit_carry(comp, xs, carry=carry, width=args.width,
+                                  stats_out=stats)
         ys = np.asarray(ys)
         if args.state_out:
             from ziria_tpu.runtime.state import save_state
             save_state(args.state_out, carry)
         if args.stats:
-            # mirror the executor's split: full-width bulk steps plus a
-            # width-1 remainder pass over leftover full iterations; a
-            # resumed checkpoint's leftover items count toward the total
-            # count the INPUT leftover (the post-run carry was just
-            # reassigned above; its leftover describes the next chunk)
-            n_avail = xs.shape[0] + n_leftover_in
-            n_iters = n_avail // low.ss.take
-            n_bulk = n_iters // low.width
-            rem = n_iters - n_bulk * low.width
-            print(f"plan: width={low.width} take={low.take} "
-                  f"emit={low.emit} bulk_steps={n_bulk} "
-                  f"remainder_iters={rem}", file=sys.stderr)
-            for lbl, reps in zip(low.labels, low.ss.reps):
+            # printed straight from the executor's own split arithmetic
+            print(f"plan: width={stats['width']} take={stats['take']} "
+                  f"emit={stats['emit']} "
+                  f"bulk_steps={stats['bulk_steps']} "
+                  f"remainder_iters={stats['remainder_iters']}",
+                  file=sys.stderr)
+            for lbl, reps in zip(stats["labels"], stats["reps"]):
                 print(f"  stage {lbl:<28s} {reps:>6d} firings/iter "
-                      f"({reps * low.width} per bulk step)",
+                      f"({reps * stats['width']} per bulk step)",
                       file=sys.stderr)
     dt = time.perf_counter() - t0
 
