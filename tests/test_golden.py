@@ -21,14 +21,25 @@ HERE = os.path.dirname(__file__)
 EXAMPLES = os.path.abspath(os.path.join(HERE, "..", "examples"))
 GOLD = os.path.join(EXAMPLES, "golden")
 
-CASES = [
-    ("scrambler", "dbg", 0.0),
-    ("fir", "dbg", 0.0),
-    ("fft64", "dbg", 1.0),
-    ("interleaver", "dbg", 0.0),
-    ("wifi_tx_bpsk", "bin", 0.0),
-    ("lut_map", "dbg", 0.0),
-]
+
+def _generator_cases():
+    """The (name, mode) table comes from the generator itself so the
+    file modes can never drift between generation and replay."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "make_golden", os.path.join(EXAMPLES, "make_golden.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return {name: mode for name, _ty, _mk, mode in mod.CASES}
+
+
+_MODES = _generator_cases()
+
+# quantized complex streams compare with atol=1; everything else exact
+_ATOL = {"fft64": 1.0}
+
+CASES = [(name, mode, _ATOL.get(name, 0.0))
+         for name, mode in _MODES.items()]
 
 
 @pytest.mark.parametrize("name,mode,atol", CASES)

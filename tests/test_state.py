@@ -234,3 +234,18 @@ def test_resume_lossy_dtype_rejected_and_none_leftover_ok():
                           carry={"stages": carry["stages"],
                                  "leftover": None})
     assert ys.shape[0] == 64
+
+
+def test_resume_narrowing_within_kind_rejected():
+    """int32 chunk into an int16 stream: lossy narrowing is refused."""
+    prog = compile_source("""
+      ext fun v_fft(x: arr[64] complex16) : arr[64] complex16
+      let comp main = read[complex16] >>>
+        repeat { (s: arr[64] complex16) <- takes 64; emits v_fft(s) }
+        >>> write[complex16]
+    """).comp
+    xs = np.random.default_rng(7).integers(
+        -500, 500, (128, 2)).astype(np.int16)
+    _, carry = run_jit_carry(prog, xs[:100])
+    with pytest.raises(ValueError, match="losslessly"):
+        run_jit_carry(prog, xs[100:].astype(np.int32), carry=carry)

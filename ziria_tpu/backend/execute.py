@@ -90,11 +90,12 @@ def run_jit_carry(comp: ir.Comp, inputs, carry=None,
                         f"{lef.shape[1:]}")
                 else:
                     if inputs.dtype != lef.dtype and not np.can_cast(
-                            inputs.dtype, lef.dtype, casting="same_kind"):
+                            inputs.dtype, lef.dtype, casting="safe"):
                         raise ValueError(
-                            f"resumed chunk dtype {inputs.dtype} is not "
-                            f"compatible with the checkpoint leftover's "
-                            f"{lef.dtype}")
+                            f"resumed chunk dtype {inputs.dtype} cannot "
+                            f"be losslessly cast to the checkpoint "
+                            f"leftover's {lef.dtype}; cast the chunk "
+                            f"explicitly if the narrowing is intended")
                     inputs = np.concatenate(
                         [lef, inputs.astype(lef.dtype, copy=False)],
                         axis=0)

@@ -93,11 +93,19 @@ class PPLowered:
 
 def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
                          in_item: jax.ShapeDtypeStruct = None,
-                         width: int = 1) -> PPLowered:
+                         width: int = 1,
+                         batch_axis: Optional[str] = None) -> PPLowered:
     """Lower a ParPipe pipeline onto `mesh[axis]`, one segment per device.
 
     `in_item` is the shape/dtype of ONE input stream item (default: f32
     scalar). The number of ParPipe segments must equal the axis size.
+
+    With ``batch_axis`` set (a second mesh axis, e.g. a (dp, pp) 2-D
+    mesh), ``run`` takes a BATCH of independent streams — shape
+    (B, M, take, *item) — sharded over `batch_axis`; every dp row runs
+    its own software-pipelined stream over the pp axis. This composes
+    the framework's two parallel axes (SURVEY.md §2.4): frame/stream
+    batching × stage parallelism, on one mesh.
     """
     segs = ir.par_segments(comp)
     K = len(segs)
@@ -165,7 +173,7 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
 
     branches = [make_branch(k) for k in range(K)]
 
-    def spmd(xs):
+    def spmd_one(xs):
         """Per-device program; xs replicated (M+K-1, take, *item)."""
         idx = lax.axis_index(axis)
 
@@ -187,19 +195,36 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
         (_, _), ys = lax.scan(macro, (init_carries, init_slots), (xs, steps))
         return ys
 
-    spec = P(*([None] * (len(out_struct.shape) + 1)))
-    mapped = shard_map(spmd, mesh=mesh, in_specs=P(), out_specs=spec,
-                       check_vma=False)
+    if batch_axis is None:
+        spec_in = P()
+        spec_out = P(*([None] * (len(out_struct.shape) + 1)))
+        spmd = spmd_one
+    else:
+        # each dp row holds its local shard of streams; vmap runs the
+        # pipeline per stream (the pp collectives batch under vmap)
+        spec_in = P(batch_axis)
+        spec_out = P(batch_axis, *([None] * (len(out_struct.shape) + 1)))
+
+        def spmd(xs_b):
+            return jax.vmap(spmd_one)(xs_b)
+
+    mapped = shard_map(spmd, mesh=mesh, in_specs=spec_in,
+                       out_specs=spec_out, check_vma=False)
     jitted = jax.jit(mapped)
+
+    t_axis = 0 if batch_axis is None else 1
 
     def run(xs):
         xs = jnp.asarray(xs)
-        M = xs.shape[0]
         if K > 1:  # trailing dummies flush the pipeline
-            pad = jnp.zeros((K - 1,) + xs.shape[1:], xs.dtype)
-            xs = jnp.concatenate([xs, pad], axis=0)
+            pad_shape = list(xs.shape)
+            pad_shape[t_axis] = K - 1
+            xs = jnp.concatenate(
+                [xs, jnp.zeros(pad_shape, xs.dtype)], axis=t_axis)
         ys = jitted(xs)
-        return ys[K - 1:] if K > 1 else ys
+        if K > 1:
+            ys = ys[K - 1:] if batch_axis is None else ys[:, K - 1:]
+        return ys
 
     return PPLowered(run=run, take=lows[0].take, emit=lows[-1].emit,
                      n_stages=K, labels=tuple(s.label() for s in segs))
