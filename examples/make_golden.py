@@ -43,6 +43,7 @@ CASES = [
     ("wifi_tx_bpsk", "bit", lambda: _bits(384, 103), "bin"),
     ("lut_map", "int8",
      lambda: np.arange(-128, 128, dtype=np.int8), "dbg"),
+    ("qam16", "bit", lambda: _bits(64 * 4, 104), "dbg"),
 ]
 
 

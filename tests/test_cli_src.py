@@ -161,3 +161,16 @@ def test_lut_map_autolut_flag_matrix(tmp_path):
     # spot-check the function: x=0b00001011 -> nibble 1011 reversed
     # 1101=13, parity of high nibble 0000 is 0
     assert base[128 + 0b1011] == 13
+
+
+@pytest.mark.parametrize("backend", ["interp", "jit"])
+def test_qam16_matches_modulate_oracle(tmp_path, backend):
+    from ziria_tpu.ops.modulate import np_modulate_ref
+
+    src = os.path.join(EXAMPLES, "qam16.zir")
+    rng = np.random.default_rng(21)
+    bits = rng.integers(0, 2, 64 * 4).astype(np.uint8)
+    out = _run_cli(src, bits, "bit", tmp_path, "dbg", backend)
+    want = np_modulate_ref(bits, 4) * 1024.0
+    got = out[:, 0].astype(np.float64) + 1j * out[:, 1].astype(np.float64)
+    np.testing.assert_allclose(got, want, atol=1.0)

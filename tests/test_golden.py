@@ -36,7 +36,7 @@ def _generator_cases():
 _MODES = _generator_cases()
 
 # quantized complex streams compare with atol=1; everything else exact
-_ATOL = {"fft64": 1.0}
+_ATOL = {"fft64": 1.0, "qam16": 1.0}
 
 CASES = [(name, mode, _ATOL.get(name, 0.0))
          for name, mode in _MODES.items()]

@@ -17,9 +17,12 @@ predecessor is computed arithmetically as ``((s & 31) << 1) | d``.
 
 Two kernels:
   1. ACS sweep  — grid (batch_tiles, T); streams per-step decision planes
-     (T, 64, 128) uint8 to HBM, keeps metrics (64, 128) f32 in scratch.
+     to HBM **bit-packed 8 states per byte** ((T, 8, 128) uint8 — an 8x
+     cut in the kernel's dominant HBM stream vs storing the raw (64, 128)
+     plane), keeps metrics (64, 128) f32 in scratch.
   2. Traceback — grid (batch_tiles, T) with a reversed index map; walks
-     the decision planes backward, one (128,)-lane state vector in
+     the packed planes backward (one-hot row select + per-lane variable
+     shift unpacks the survivor bit), one (128,)-lane state vector in
      scratch, emitting one bit plane per step.
 
 The module-level tables come from ops/viterbi.py so the Pallas kernel and
@@ -68,7 +71,8 @@ def _acs_kernel(llr_ref, dec_ref, metrics_out_ref, m_ref):
     """One trellis time-step for one batch tile.
 
     llr_ref: (1, 2, 128) this step's (A, B) soft inputs per lane.
-    dec_ref: (1, 64, 128) uint8 decision plane out (this step).
+    dec_ref: (1, 8, 128) uint8 packed decision plane out (this step):
+      byte i, bit j holds the survivor bit of state 8*i + j.
     metrics_out_ref: (64, 128) f32 — final metrics (last write wins).
     m_ref: (64, 128) f32 VMEM scratch — path metrics across the sweep.
     """
@@ -97,7 +101,18 @@ def _acs_kernel(llr_ref, dec_ref, metrics_out_ref, m_ref):
 
     m_ref[:] = new
     metrics_out_ref[0] = new
-    dec_ref[0, 0] = dec.astype(jnp.uint8)
+    # pack 8 consecutive states per byte: byte i bit j = dec[8i + j].
+    # Formulated as contiguous single-row slices + shifts + concat (the
+    # most conservative Mosaic ops — no sublane-splitting reshape, no
+    # strided slice); unrolls to 64 cheap VPU adds.
+    d32 = dec.astype(jnp.int32)                          # (64, 128)
+    rows = []
+    for i in range(8):
+        acc = d32[8 * i: 8 * i + 1]
+        for j in range(1, 8):
+            acc = acc + (d32[8 * i + j: 8 * i + j + 1] << j)
+        rows.append(acc)
+    dec_ref[0, 0] = jnp.concatenate(rows, axis=0).astype(jnp.uint8)
 
 
 def _traceback_kernel(dec_ref, metrics_ref, bits_ref, s_ref):
@@ -105,7 +120,7 @@ def _traceback_kernel(dec_ref, metrics_ref, bits_ref, s_ref):
     state (one-hot sum — no per-lane gather), emit the decoded bit, move
     to the predecessor.
 
-    dec_ref: (1, 64, 128) decision plane for trellis step T-1-t.
+    dec_ref: (1, 8, 128) packed decision plane for trellis step T-1-t.
     metrics_ref: (64, 128) final path metrics (used only at t == 0).
     bits_ref: (1, 8, 128) int32 out — decoded bit plane, row 0 carries it
       (8 sublanes keeps the store tile-aligned).
@@ -119,10 +134,11 @@ def _traceback_kernel(dec_ref, metrics_ref, bits_ref, s_ref):
         s_ref[:] = jnp.broadcast_to(end[None, :], (8, LANES))
 
     state = s_ref[0:1, :]                              # (1, 128)
-    dec = dec_ref[0, 0].astype(jnp.int32)              # (64, 128)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (N_STATES, LANES), 0)
-    onehot = (rows == state).astype(jnp.int32)
-    d = jnp.sum(dec * onehot, axis=0, keepdims=True)   # (1, 128)
+    packed = dec_ref[0, 0].astype(jnp.int32)           # (8, 128)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (8, LANES), 0)
+    onehot = (rows == (state >> 3)).astype(jnp.int32)  # select byte row
+    byte = jnp.sum(packed * onehot, axis=0, keepdims=True)   # (1, 128)
+    d = (byte >> (state & 7)) & 1                      # unpack bit
 
     bit = state >> 5
     prev = ((state & 31) << 1) | d
@@ -148,11 +164,11 @@ def _decode_tiles(llrs, interpret: bool):
         grid=(nb, T),
         in_specs=[pl.BlockSpec((1, 1, 2, LANES), lambda b, t: (b, t, 0, 0))],
         out_specs=[
-            pl.BlockSpec((1, 1, N_STATES, LANES), lambda b, t: (b, t, 0, 0)),
+            pl.BlockSpec((1, 1, 8, LANES), lambda b, t: (b, t, 0, 0)),
             pl.BlockSpec((1, N_STATES, LANES), lambda b, t: (b, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nb, T, N_STATES, LANES), jnp.uint8),
+            jax.ShapeDtypeStruct((nb, T, 8, LANES), jnp.uint8),
             jax.ShapeDtypeStruct((nb, N_STATES, LANES), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((N_STATES, LANES), jnp.float32)],
@@ -163,7 +179,7 @@ def _decode_tiles(llrs, interpret: bool):
         _traceback_kernel,
         grid=(nb, T),
         in_specs=[
-            pl.BlockSpec((1, 1, N_STATES, LANES),
+            pl.BlockSpec((1, 1, 8, LANES),
                          lambda b, t, _T=T: (b, _T - 1 - t, 0, 0)),
             pl.BlockSpec((1, N_STATES, LANES), lambda b, t: (b, 0, 0)),
         ],

@@ -83,3 +83,55 @@ def assert_stream_eq(got, want, atol: float = 0.0, rtol: float = 0.0,
     rep = stream_diff(got, want, atol=atol, rtol=rtol, name=name)
     if not rep:
         raise AssertionError(rep.message)
+
+
+# --------------------------------------------------------------------------
+# CLI — the reference tools/BlinkDiff executable's role:
+#   python -m ziria_tpu.utils.diff got.dbg want.ground \
+#       --type=complex16 --mode=dbg --atol=1 [--prefix]
+# exit 0 on match, 1 on mismatch (message on stderr).
+# --------------------------------------------------------------------------
+
+
+def _diff_main(argv=None) -> int:
+    import argparse
+    import sys
+
+    from ziria_tpu.runtime.buffers import ITEM_TYPES, StreamSpec, \
+        read_stream
+
+    p = argparse.ArgumentParser(
+        prog="python -m ziria_tpu.utils.diff",
+        description="Golden-file comparator (BlinkDiff role): exact for "
+                    "integer/bit streams, tolerance for floats/complex")
+    p.add_argument("got")
+    p.add_argument("want")
+    p.add_argument("--type", default="int32", choices=ITEM_TYPES)
+    p.add_argument("--mode", default="dbg", choices=["dbg", "bin"])
+    p.add_argument("--atol", type=float, default=0.0)
+    p.add_argument("--rtol", type=float, default=0.0)
+    p.add_argument("--prefix", action="store_true",
+                   help="compare only the common prefix (bin-mode bit "
+                        "streams pad to byte boundaries)")
+    args = p.parse_args(argv)
+
+    got = read_stream(StreamSpec(ty=args.type, path=args.got,
+                                 mode=args.mode))
+    want = read_stream(StreamSpec(ty=args.type, path=args.want,
+                                  mode=args.mode))
+    if args.prefix:
+        n = min(got.shape[0], want.shape[0])
+        got, want = got[:n], want[:n]
+    if args.atol or args.rtol:
+        got = got.astype(np.float64)
+        want = want.astype(np.float64)
+    rep = stream_diff(got, want, atol=args.atol, rtol=args.rtol,
+                      name=args.got)
+    print(rep.message, file=sys.stderr if not rep.ok else sys.stdout)
+    return 0 if rep.ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_diff_main())
