@@ -7,25 +7,51 @@ descramble) at 54 Mbps, frames batched on one chip.
 
 Baseline (BASELINE.md self-measured policy — the reference mount was
 empty): the same receiver chain implemented in straightforward
-vectorized numpy on the host CPU (np.fft, gather deinterleave, 64-state
-vectorized-ACS Viterbi) — a stand-in for the reference's single-core C
-backend. The correctness gate requires the decoded PSDU to equal the
-transmitted bits before any number is printed.
+vectorized numpy on the host CPU with the native C Viterbi
+(a stand-in for the reference's single-core C backend). The correctness
+gate requires the decoded PSDU to equal the transmitted bits before any
+number is printed.
+
+Resilience (round-2 hardening): the axon TPU backend has been observed
+to hang indefinitely during backend init. The *parent* process
+therefore pins itself to the CPU backend (jax.config wins over the
+axon plugin, per tests/conftest.py) and always measures the numpy
+baseline; the TPU measurement runs in a *subprocess* with bounded
+timeouts and retries. On final TPU failure the script still exits 0
+and emits a JSON line carrying the numpy baseline and an explicit
+``"tpu": "unavailable"`` marker, so the round records something.
 """
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+# Per-attempt timeouts (seconds) for the TPU child. First attempt is
+# generous (first axon compile is slow, ~20-40 s healthy, but init
+# flakes have hung >9 min); later attempts shorter.
+TPU_TRY_TIMEOUTS = (600, 420, 300)
+TPU_RETRY_BACKOFF = 20  # seconds between attempts
+
+# v5e single-chip peaks for the roofline sanity line.
+V5E_HBM_GBPS = 819.0
+V5E_BF16_TFLOPS = 197.0
 
 
 def _block(out):
     """Force completion of everything queued before `out`.
 
-    block_until_ready() under the axon tunnel returns before the device
-    is actually done (measured: it reported rates exceeding HBM
-    bandwidth); a tiny device->host copy of the result is an honest
-    fence because transfers are ordered after the producing computation.
+    block_until_ready() under the axon tunnel has been observed to
+    return before the device is actually done (it reported rates
+    exceeding HBM bandwidth); a tiny device->host copy of the result is
+    an honest fence because transfers are ordered after the producing
+    computation. The child also measures the skew between the two
+    fences and reports it as ``fence_skew`` so the workaround is
+    inspectable rather than folklore.
     """
     import jax
     leaves = [a for a in jax.tree.leaves(out) if hasattr(a, "ndim")]
@@ -33,17 +59,19 @@ def _block(out):
         np.asarray(a.ravel()[:1] if a.ndim else a)
 
 
-def _time(fn, *args, reps=5):
+def _time(fn, *args, reps=5, fence=_block):
     """Average seconds per call: queue `reps` async calls, fence once.
 
-    reps amortizes the host<->device round-trip (~70 ms through the axon
-    tunnel) which would otherwise dominate millisecond-scale kernels.
+    reps amortizes the host<->device round-trip (~70 ms through the
+    axon tunnel) which would otherwise dominate millisecond-scale
+    kernels.
     """
-    _block(fn(*args))  # warm-up / compile, fully drained before timing
+    fence(fn(*args))  # warm-up / compile, fully drained before timing
     t0 = time.perf_counter()
+    out = None
     for _ in range(reps):
         out = fn(*args)
-    _block(out)
+    fence(out)
     return (time.perf_counter() - t0) / reps
 
 
@@ -56,8 +84,6 @@ def np_rx_decode(frame, rate, n_sym, n_psdu_bits):
     from ziria_tpu.ops.ofdm import (DATA_BINS, LTS_FREQ, PILOT_BINS,
                                     PILOT_POLARITY, PILOT_VALS, TIME_SCALE)
     from ziria_tpu.ops.scramble import np_lfsr_sequence_127
-    from ziria_tpu.ops.viterbi import _OUT_A, _OUT_B, _PRED
-
     x = frame[..., 0] + 1j * frame[..., 1]
     # channel estimate from LTS
     ref = np.zeros(64, np.float32)
@@ -94,38 +120,32 @@ def np_rx_decode(frame, rate, n_sym, n_psdu_bits):
     dep = dep.reshape(-1, 2)
 
     # Viterbi: native C decoder (the honest C-backend stand-in; the
-    # reference's hot kernel is a C SORA brick). Fall back to a python
-    # ACS loop only if no toolchain exists — that fallback is NOT a fair
-    # baseline and the ratio should be read accordingly.
+    # reference's hot kernel is a C SORA brick). Fall back to the shared
+    # numpy ACS (ops/viterbi.np_viterbi_decode) only if no toolchain
+    # exists — that fallback is NOT a fair baseline and the ratio should
+    # be read accordingly.
     from ziria_tpu.runtime.native_lib import load, viterbi_decode_native
     if load() is not None:
         bits = viterbi_decode_native(dep)
     else:
-        metrics = np.full(64, -1e30, np.float32)
-        metrics[0] = 0.0
-        T = dep.shape[0]
-        decisions = np.zeros((T, 64), np.uint8)
-        for k in range(T):
-            cand = metrics[_PRED] + _OUT_A * dep[k, 0] + _OUT_B * dep[k, 1]
-            decisions[k] = np.argmax(cand, 1)
-            metrics = cand.max(1)
-            metrics -= metrics.max()
-        state = int(np.argmax(metrics))
-        bits = np.zeros(T, np.uint8)
-        for k in range(T - 1, -1, -1):
-            bits[k] = state >> 5
-            state = _PRED[state, decisions[k, state]]
+        from ziria_tpu.ops.viterbi import np_viterbi_decode
+        bits = np_viterbi_decode(dep)
 
-    seq = np.resize(np_lfsr_sequence_127(np.ones(7, np.uint8)), bits.size)
-    clear = bits ^ seq  # descramble (fixed seed stand-in, same op count)
+    from ziria_tpu.phy.wifi.tx import DEFAULT_SCRAMBLER_SEED, _seed_bits_np
+    seq = np.resize(
+        np_lfsr_sequence_127(_seed_bits_np(DEFAULT_SCRAMBLER_SEED)),
+        bits.size)
+    clear = bits ^ seq  # descramble with the frame's actual seed
     return clear[16: 16 + n_psdu_bits]  # 16 SERVICE bits, then the PSDU
 
 
-def main():
-    import jax
+# ------------------------------------------------------------ shared setup
+
+def _setup():
+    """Build the bench frame + expected bits (backend-agnostic)."""
     import jax.numpy as jnp
 
-    from ziria_tpu.phy.wifi import rx, tx
+    from ziria_tpu.phy.wifi import tx
     from ziria_tpu.phy.wifi.params import RATES, n_symbols
     from ziria_tpu.utils.bits import bytes_to_bits
 
@@ -138,34 +158,233 @@ def main():
     rng = np.random.default_rng(0)
     psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
     frame = np.asarray(tx.encode_frame(psdu, 54))
+    want = np.asarray(bytes_to_bits(psdu))
+    del jnp
+    return rate, n_sym, n_psdu_bits, frame_len, frame, want
 
-    # correctness gate
+
+def _roofline(B, frame_len, n_sym, n_psdu_bits, t):
+    """Rough bytes/flops accounting → % of v5e single-chip peaks.
+
+    Dominant terms per frame: complex input samples (f32 pairs), the
+    64-pt FFT per OFDM symbol (~n*log2(n)*5 real flops, complex), the
+    Viterbi ACS (64 states x 2 ops x T steps), demap/deinterleave
+    elementwise traffic. This is a sanity line, not a profile.
+    """
+    bytes_per_frame = (
+        frame_len * 8                 # input samples f32 (re, im)
+        + n_sym * 64 * 8 * 3          # FFT in/out + equalize traffic
+        + n_sym * 48 * 6 * 4 * 2      # LLRs write+read
+        + n_psdu_bits * 1)            # output bits
+    flops_per_frame = (
+        n_sym * 64 * 6 * 5 * 2        # FFT (radix-2 estimate, complex)
+        + n_sym * 48 * 40             # equalize + pilot track + demap
+        + (n_psdu_bits + 16 + 6) * 64 * 4)  # Viterbi ACS add/compare/sel
+    achieved_gbps = B * bytes_per_frame / t / 1e9
+    achieved_tflops = B * flops_per_frame / t / 1e12
+    return {
+        "achieved_gbps": round(achieved_gbps, 2),
+        "pct_hbm_peak": round(100 * achieved_gbps / V5E_HBM_GBPS, 2),
+        "achieved_tflops": round(achieved_tflops, 3),
+        "pct_flops_peak": round(100 * achieved_tflops / V5E_BF16_TFLOPS, 3),
+    }
+
+
+# ------------------------------------------------------------ TPU child
+
+def _child_main():
+    """Runs in a subprocess with the real (axon/TPU) backend.
+
+    Prints progress to stderr and exactly one JSON object to stdout.
+    """
+    def note(msg):
+        print(f"[bench-child] +{time.time() - t0:.1f}s {msg}",
+              file=sys.stderr, flush=True)
+
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+    note("jax imported; touching backend")
+    devs = jax.devices()
+    dev = devs[0]
+    note(f"backend up: {dev.platform} / {getattr(dev, 'device_kind', '?')}"
+         f" x{len(devs)}")
+    if dev.platform == "cpu":
+        # a CPU fallback must NOT be reported as a per-chip number —
+        # fail so the parent records tpu: unavailable instead
+        note("backend is CPU, not a TPU — refusing to fake a chip metric")
+        sys.exit(3)
+
+    from ziria_tpu.phy.wifi import rx
+
+    rate, n_sym, n_psdu_bits, frame_len, frame, want = _setup()
+    note("frame encoded")
+
+    # correctness gate (single frame)
     got, _ = rx.decode_data_static(jnp.asarray(frame), rate, n_sym,
                                    n_psdu_bits)
-    want = np.asarray(bytes_to_bits(psdu))
     assert np.array_equal(np.asarray(got), want), "bench RX decode mismatch"
+    note("single-frame correctness gate passed")
 
-    # --- TPU: batched frames through the Pallas-Viterbi fast path
+    # Pallas-on-Mosaic proof: decode with interpret=False explicitly and
+    # compare to the lax.scan oracle. On a real TPU this compiles the
+    # kernels with Mosaic; any Mosaic rejection fails loudly here.
+    pallas_mosaic = False
+    if dev.platform != "cpu":
+        from ziria_tpu.ops import viterbi, viterbi_pallas
+        rng = np.random.default_rng(1)
+        llrs = jnp.asarray(rng.normal(size=(4, 1024, 2)).astype(np.float32))
+        hard = viterbi_pallas.viterbi_decode_batch(llrs, interpret=False)
+        oracle = jax.vmap(viterbi.viterbi_decode)(llrs)
+        assert np.array_equal(np.asarray(hard), np.asarray(oracle)), \
+            "Pallas (Mosaic) Viterbi != lax.scan oracle"
+        pallas_mosaic = True
+        note("Pallas kernels compiled by Mosaic, match oracle")
+
+    # batched steady-state decode
     B = 128
     frames = jnp.asarray(np.broadcast_to(frame, (B,) + frame.shape).copy())
-
     decode = jax.jit(
         lambda f: rx.decode_data_batch(f, rate, n_sym, n_psdu_bits)[0])
     got_b = np.asarray(decode(frames))
     assert np.array_equal(got_b[0], want) and np.array_equal(got_b[-1], want)
-    t_tpu = _time(decode, frames, reps=50)
-    sps = B * frame_len / t_tpu
+    note("batched correctness gate passed; timing")
 
-    # --- numpy baseline (single frame, scaled)
-    t_np = _time(np_rx_decode, frame, rate, n_sym, n_psdu_bits, reps=3)
+    t_tpu = _time(decode, frames, reps=50)
+    # fence-skew diagnostic: same timing with block_until_ready only.
+    t_bur = _time(decode, frames, reps=50,
+                  fence=lambda o: jax.block_until_ready(o))
+    sps = B * frame_len / t_tpu
+    note(f"t_copy_fence={t_tpu*1e3:.3f} ms t_block_until_ready="
+         f"{t_bur*1e3:.3f} ms")
+
+    out = {
+        "tpu_sps": sps,
+        "t_step_s": t_tpu,
+        "batch": B,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "pallas_mosaic": pallas_mosaic,
+        "fence_skew": round(t_bur / t_tpu, 3),
+        "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
+    }
+    print(json.dumps(out), flush=True)
+
+
+def _run_one_child(tmo: int):
+    """One bounded child attempt. Runs the child in its own process
+    group and kills the WHOLE group on timeout: the axon runtime spawns
+    helper processes that inherit the output pipes, and killing only
+    the direct child would leave subprocess.run blocked on pipe EOF —
+    the exact unbounded hang this harness exists to prevent."""
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--tpu-child"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        start_new_session=True)
+    try:
+        out, errtxt = proc.communicate(timeout=tmo)
+        return proc.returncode, out, errtxt
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return None, "", ""
+
+
+def _run_child(timeouts):
+    """Run the TPU child with bounded retries; return dict or error info."""
+    err = None
+    for i, tmo in enumerate(timeouts):
+        if i:
+            time.sleep(TPU_RETRY_BACKOFF)
+        rc, out, errtxt = _run_one_child(tmo)
+        if rc is None:
+            err = f"attempt {i + 1}: timeout after {tmo}s (backend hang)"
+        elif rc == 0:
+            try:
+                return json.loads(out.strip().splitlines()[-1]), None
+            except (json.JSONDecodeError, IndexError):
+                err = f"attempt {i + 1}: unparseable child stdout"
+        else:
+            tail = (errtxt or "").strip().splitlines()[-3:]
+            err = f"attempt {i + 1}: rc={rc}: " + " | ".join(tail)
+        print(f"[bench] {err}", file=sys.stderr, flush=True)
+    return None, err
+
+
+# ------------------------------------------------------------------ parent
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tpu-child", action="store_true",
+                    help="internal: run the TPU measurement")
+    ap.add_argument("--no-tpu", action="store_true",
+                    help="skip the TPU child (numpy baseline only)")
+    ap.add_argument("--tries", type=int, default=len(TPU_TRY_TIMEOUTS))
+    args = ap.parse_args()
+
+    if args.tpu_child:
+        _child_main()
+        return
+
+    # Parent stays on CPU no matter what the axon plugin wants
+    # (jax.config wins over the plugin; see tests/conftest.py).
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    rate, n_sym, n_psdu_bits, frame_len, frame, want = _setup()
+
+    # numpy-baseline correctness gate, then timing
+    got_np = np_rx_decode(frame, rate, n_sym, n_psdu_bits)
+    assert np.array_equal(got_np, want), "numpy baseline decode mismatch"
+    t_np = _time(np_rx_decode, frame, rate, n_sym, n_psdu_bits, reps=3,
+                 fence=lambda o: None)
     sps_np = frame_len / t_np
 
-    print(json.dumps({
+    # the baseline's own hot-kernel throughput, so the ratio's
+    # denominator is inspectable (the C ACS loop is portable scalar C,
+    # not hand-SIMD like the reference's SORA brick — stated here).
+    from ziria_tpu.runtime.native_lib import load, viterbi_decode_native
+    vit_c_mbps = None
+    if load() is not None:
+        nb = (n_psdu_bits + 16 + 6)
+        dep = np.random.default_rng(2).normal(
+            size=(nb, 2)).astype(np.float32)
+        t_v = _time(viterbi_decode_native, dep, reps=5, fence=lambda o: None)
+        vit_c_mbps = round(nb / t_v / 1e6, 2)
+
+    result = {
         "metric": "80211a_rx_samples_per_sec_per_chip",
-        "value": round(sps, 1),
         "unit": "samples/s",
-        "vs_baseline": round(sps / sps_np, 3),
-    }))
+        "numpy_baseline_sps": round(sps_np, 1),
+        "viterbi_c_scalar_mbps": vit_c_mbps,
+    }
+
+    child, err = (None, "skipped (--no-tpu)") if args.no_tpu else \
+        _run_child(TPU_TRY_TIMEOUTS[:args.tries])
+
+    if child is not None:
+        result["value"] = round(child["tpu_sps"], 1)
+        result["vs_baseline"] = round(child["tpu_sps"] / sps_np, 3)
+        for k in ("platform", "device_kind", "batch", "t_step_s",
+                  "pallas_mosaic", "fence_skew", "roofline"):
+            result[k] = child.get(k)
+    else:
+        # TPU unreachable: record the baseline so the round has data.
+        result["value"] = round(sps_np, 1)
+        result["vs_baseline"] = 1.0
+        result["tpu"] = "unavailable"
+        result["tpu_error"] = err
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

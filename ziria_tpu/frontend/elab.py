@@ -714,22 +714,31 @@ def _domain_of(ty: Optional[A.Ty]) -> Optional[int]:
 
 
 def _device_init(init: Any, ty: A.Ty) -> Any:
-    """Force var-decl initializers to concrete jnp values with the
-    declared dtype, so MapAccum carries keep a stable dtype under scan."""
+    """Force var-decl initializers to concrete array values with the
+    declared dtype, so MapAccum carries keep a stable dtype under scan.
+
+    numpy (not jnp): the jit backend converts carries at the scan
+    boundary, while the interpreter keeps evaluating stream-level vars
+    on the numpy fast path (eval._np_ok). Traced initializers (closures
+    over a traced env) still yield jnp values via eval's own dispatch."""
     if callable(init):
         def run(env, _i=init, _ty=ty):
-            return _to_jnp(_i(env), _ty)
+            return _to_arr(_i(env), _ty)
         return run
-    return _to_jnp(init, ty)
+    return _to_arr(init, ty)
 
 
-def _to_jnp(v: Any, ty: A.Ty):
-    import jax.numpy as jnp
+def _to_arr(v: Any, ty: A.Ty):
     if isinstance(v, dict):
         return v
+    if not E._np_ok(v):
+        import jax.numpy as jnp
+        if E.is_static(v) and isinstance(ty, A.TBase):
+            return jnp.asarray(v, E.base_dtype(ty.name))
+        return jnp.asarray(v)
     if E.is_static(v) and isinstance(ty, A.TBase):
-        return jnp.asarray(v, E.base_dtype(ty.name))
-    return jnp.asarray(v)
+        return np.asarray(v, E.base_dtype(ty.name))
+    return np.asarray(v)
 
 
 def _input_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str):
@@ -737,11 +746,13 @@ def _input_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str):
         return comp, None
     name = _file_ty(ty, src)
     if name in ("complex16", "complex32"):
-        import jax.numpy as jnp
-
         def to_c64(p):
-            p = jnp.asarray(p, jnp.float32)
-            return (p[0] + 1j * p[1]).astype(jnp.complex64)
+            # numpy for concrete items (the interpreter's per-sample
+            # loop — jnp here would drag every downstream op onto the
+            # jax dispatch path), jnp under the jit backend's trace
+            xp = np if E._np_ok(p) else E._jnp()
+            p = xp.asarray(p, np.float32)
+            return (p[0] + 1j * p[1]).astype(np.complex64)
 
         return ir.Pipe(ir.Map(to_c64, name="iq_to_c64"), comp), name
     return comp, name
@@ -752,13 +763,13 @@ def _output_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str):
         return comp, None
     name = _file_ty(ty, src)
     if name in ("complex16", "complex32"):
-        import jax.numpy as jnp
-        dt = jnp.int16 if name == "complex16" else jnp.int32
+        dt = np.int16 if name == "complex16" else np.int32
 
         def to_iq(z, _dt=dt):
-            z = jnp.asarray(z, jnp.complex64)
-            return jnp.stack([jnp.round(z.real),
-                              jnp.round(z.imag)]).astype(_dt)
+            xp = np if E._np_ok(z) else E._jnp()
+            z = xp.asarray(z, np.complex64)
+            return xp.stack([xp.round(z.real),
+                             xp.round(z.imag)]).astype(_dt)
 
         return ir.Pipe(comp, ir.Map(to_iq, name="c64_to_iq")), name
     return comp, name

@@ -22,6 +22,21 @@ def _jnp():
     return jnp
 
 
+def _xp(args):
+    """numpy for concrete values, jnp only under a jax trace.
+
+    The interpreter backend evaluates ext calls on concrete scalars and
+    arrays in tight per-sample loops; returning jax Arrays there makes
+    every subsequent indexing/arithmetic a device dispatch (measured
+    ~200x slower than numpy). The jit backend traces through the same
+    registry with Tracer arguments, which must stay in jnp.
+    """
+    from jax.core import Tracer
+    if any(isinstance(a, Tracer) for a in args):
+        return _jnp()
+    return np
+
+
 def _length(x) -> int:
     shape = np.shape(x)
     if not shape:
@@ -31,24 +46,25 @@ def _length(x) -> int:
 
 def _f(fn_name: str) -> Callable:
     def wrapper(*args):
-        jnp = _jnp()
-        return getattr(jnp, fn_name)(*[jnp.asarray(a) for a in args])
+        xp = _xp(args)
+        return getattr(xp, fn_name)(*[xp.asarray(a) for a in args])
     wrapper.__name__ = fn_name
     return wrapper
 
 
 def _fft(x):
-    jnp = _jnp()
-    return jnp.fft.fft(jnp.asarray(x, jnp.complex64)).astype(jnp.complex64)
+    xp = _xp((x,))
+    return xp.fft.fft(xp.asarray(x, xp.complex64)).astype(xp.complex64)
 
 
 def _ifft(x):
-    jnp = _jnp()
-    return jnp.fft.ifft(jnp.asarray(x, jnp.complex64)).astype(jnp.complex64)
+    xp = _xp((x,))
+    return xp.fft.ifft(xp.asarray(x, xp.complex64)).astype(xp.complex64)
 
 
 def _sum(x):
-    return _jnp().sum(_jnp().asarray(x), axis=0)
+    xp = _xp((x,))
+    return xp.sum(xp.asarray(x), axis=0)
 
 
 # always available, no declaration needed
@@ -81,6 +97,40 @@ EXTERNALS: Dict[str, Callable] = {
     "fft": _fft,
     "ifft": _ifft,
 }
+
+
+def _viterbi_soft(llrs, npairs, nbits):
+    """Block soft-decision Viterbi (K=7, g0=133o/g1=171o) over the first
+    `npairs` (A,B) LLR pairs of a padded buffer; returns a bit array of
+    half the buffer's length with the `nbits` decoded bits in front.
+
+    The language-level binding of the hot decode kernel — counterpart of
+    the reference's `ext` declaration for the SORA Viterbi brick
+    (SURVEY.md §2.2/§2.3 `decoding/viterbi.blk`): programs declare
+
+        ext fun viterbi_soft(llrs: arr[N] double, npairs: int32,
+                             nbits: int32) : arr[N/2] bit
+    """
+    from jax.core import Tracer
+
+    from ziria_tpu.ops.viterbi import np_viterbi_decode
+
+    if any(isinstance(a, Tracer) for a in (llrs, npairs, nbits)):
+        raise TypeError(
+            "ext fun viterbi_soft needs concrete (data-dependent) "
+            "lengths and runs on the interpreter backend only; the jit "
+            "backend's static-shape decode is ops/viterbi.viterbi_decode"
+            " / ops/viterbi_pallas.viterbi_decode_batch")
+    arr = np.asarray(llrs, np.float32)
+    npairs = int(np.asarray(npairs))
+    nbits = int(np.asarray(nbits))
+    bits = np_viterbi_decode(arr[: 2 * npairs], n_bits=nbits)
+    out = np.zeros(arr.shape[0] // 2, np.uint8)
+    out[:nbits] = bits
+    return out
+
+
+EXTERNALS["viterbi_soft"] = _viterbi_soft
 
 
 def register_external(name: str, fn: Callable) -> None:

@@ -110,6 +110,37 @@ def viterbi_decode_bits(coded_bits, n_bits: int = None) -> jnp.ndarray:
     return viterbi_decode(2.0 * b - 1.0, n_bits)
 
 
+def np_viterbi_decode(llrs: np.ndarray, n_bits: int = None) -> np.ndarray:
+    """Host-side numpy decode, same trellis/semantics as viterbi_decode.
+
+    The single numpy ACS implementation shared by the interpreter
+    backend's `viterbi_soft` external (frontend/externals.py) and the
+    bench's CPU baseline — vectorized over the 64 states, python loop
+    over time (the C baseline in runtime/native is the fast host path).
+    """
+    dep = np.asarray(llrs, np.float32)
+    if dep.ndim == 1:
+        dep = dep.reshape(-1, 2)
+    T = dep.shape[0]
+    pred = np.asarray(_PRED)
+    out_a = np.asarray(_OUT_A, np.float32)
+    out_b = np.asarray(_OUT_B, np.float32)
+    metrics = np.full(N_STATES, -1e30, np.float32)
+    metrics[0] = 0.0
+    decisions = np.zeros((T, N_STATES), np.uint8)
+    for k in range(T):
+        cand = metrics[pred] + out_a * dep[k, 0] + out_b * dep[k, 1]
+        decisions[k] = np.argmax(cand, 1)
+        metrics = cand.max(1)
+        metrics -= metrics.max()
+    state = int(np.argmax(metrics))
+    bits = np.zeros(T, np.uint8)
+    for k in range(T - 1, -1, -1):
+        bits[k] = state >> 5
+        state = pred[state, decisions[k, state]]
+    return bits[:n_bits] if n_bits is not None else bits
+
+
 def np_viterbi_ref(llrs: np.ndarray) -> np.ndarray:
     """Independent oracle: dict-based python Viterbi. Tests only."""
     llrs = np.asarray(llrs, np.float64).reshape(-1, 2)
