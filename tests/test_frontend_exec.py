@@ -443,10 +443,25 @@ def test_impure_let_evaluates_once_at_runtime(capsys):
 
 
 def test_negative_index_rejected():
+    # a statically-known negative index is now a *compile-time* error
+    # (round-2 typechecker); the dynamic variant below still errors at
+    # runtime
+    from ziria_tpu.frontend import ZiriaTypeError
+    with pytest.raises(ZiriaTypeError, match="out of bounds"):
+        compile_source("""
+          fun f(x: int32) : int32 {
+            var a : arr[4] int32 := {10, 20, 30, 40};
+            return a[0 - 1]
+          }
+          let comp main = read[int32] >>> map f >>> write[int32]
+        """)
+
+
+def test_negative_dynamic_index_rejected_at_runtime():
     prog = compile_source("""
       fun f(x: int32) : int32 {
         var a : arr[4] int32 := {10, 20, 30, 40};
-        return a[0 - 1]
+        return a[x - 1]
       }
       let comp main = read[int32] >>> map f >>> write[int32]
     """)

@@ -20,9 +20,11 @@ from ziria_tpu.frontend.eval import ZiriaRuntimeError
 from ziria_tpu.frontend.lexer import LexError, tokenize
 from ziria_tpu.frontend.parser import (ParseError, parse_comp, parse_expr,
                                        parse_program)
+from ziria_tpu.frontend.typecheck import ZiriaTypeError
 
 __all__ = [
     "CompiledProgram", "ElabError", "LexError", "ParseError",
-    "ZiriaRuntimeError", "compile_file", "compile_source", "parse_comp",
-    "parse_expr", "parse_program", "tokenize",
+    "ZiriaRuntimeError", "ZiriaTypeError", "compile_file",
+    "compile_source", "parse_comp", "parse_expr", "parse_program",
+    "tokenize",
 ]

@@ -611,8 +611,16 @@ class Elaborator:
                            f"unknown declaration {type(d).__name__}")
         return self
 
-    def build(self, entry: str = "main") -> CompiledProgram:
+    def build(self, entry: str = "main",
+              typecheck: bool = True) -> CompiledProgram:
         self.elaborate()
+        if typecheck:
+            # static expression typechecker (reference TcExpr/TcUnify
+            # role, SURVEY.md §2.1): dtype + array-length checking over
+            # the surface AST with located errors, before any closure
+            # can fail at runtime
+            from ziria_tpu.frontend.typecheck import check_program
+            check_program(self)
         # elaborate non-entry top comps first, in order, so entry can
         # reference them
         base = ElabEnv(self.gscope)
@@ -788,11 +796,13 @@ def _file_ty(ty: A.Ty, src: str) -> str:
 
 
 def compile_source(src: str, src_name: str = "<input>",
-                   entry: str = "main") -> CompiledProgram:
+                   entry: str = "main",
+                   typecheck: bool = True) -> CompiledProgram:
     prog = parse_program(src, src_name)
-    return Elaborator(prog, src_name).build(entry)
+    return Elaborator(prog, src_name).build(entry, typecheck=typecheck)
 
 
-def compile_file(path: str, entry: str = "main") -> CompiledProgram:
+def compile_file(path: str, entry: str = "main",
+                 typecheck: bool = True) -> CompiledProgram:
     with open(path, "r") as fh:
-        return compile_source(fh.read(), path, entry)
+        return compile_source(fh.read(), path, entry, typecheck=typecheck)

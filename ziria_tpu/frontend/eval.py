@@ -473,7 +473,13 @@ def eval_expr(e: A.Expr, scope: Scope, ctx: Ctx) -> Any:
             _check_index(int(i), arr, e.loc)
             return arr[int(i)]
         if _np_ok(arr, i):
-            return np.asarray(arr)[i]
+            ia = np.asarray(i)
+            if ia.ndim == 0:
+                # concrete scalar index: enforce C bounds discipline (no
+                # Python negative wraparound) on the numpy fast path too
+                _check_index(int(ia), arr, e.loc)
+                return np.asarray(arr)[int(ia)]
+            return np.asarray(arr)[ia]
         return jnp.asarray(arr)[i]
     if isinstance(e, A.ESlice):
         arr = eval_expr(e.arr, scope, ctx)
@@ -722,6 +728,8 @@ def _assign_lval(lval: A.Expr, v: Any, scope: Scope, ctx: Ctx) -> None:
         i = eval_expr(lval.i, scope, ctx)
         if is_static(i):
             _check_index(int(i), old, lval.loc)
+        elif _np_ok(i) and np.ndim(i) == 0:
+            _check_index(int(np.asarray(i)), old, lval.loc)
         if _np_ok(old, i, v):
             # concrete path: copy-on-write keeps the functional
             # semantics (arrays are values) at numpy speed
