@@ -467,3 +467,74 @@ def test_negative_dynamic_index_rejected_at_runtime():
     """)
     with pytest.raises(ZiriaRuntimeError, match="out of bounds"):
         run(prog.comp, [np.int32(0)])
+
+
+# ------------------------------------------------- ADVICE r1 regressions
+
+
+def test_narrow_int_promotion_matches_c():
+    """int16 operands promote to int32 before arithmetic (C integer
+    promotion): 300*300 is 90000 mid-expression on EVERY path, and
+    narrows to 24464 only when assigned back to an int16 slot."""
+    prog = compile_source("""
+      fun f(x: int16) : int32 {
+        var wide : int32;
+        var narrow : int16;
+        wide := x * x;
+        narrow := x * x;
+        return wide - narrow
+      }
+      let comp main = read[int16] >>> map f >>> write[int32]
+    """)
+    out = both_backends(prog, np.array([300], np.int16))
+    # 90000 - 24464 = 65536 on both paths
+    np.testing.assert_array_equal(out, [65536])
+
+
+def test_expression_statement_with_operator():
+    """`f(x) + g(y);` is a legal (if useless) expression statement."""
+    prog = compile_source("""
+      fun g(y: int32) : int32 { return y + 1 }
+      fun f(x: int32) : int32 {
+        g(x) + g(x);
+        return x
+      }
+      let comp main = read[int32] >>> map f >>> write[int32]
+    """)
+    out = both_backends(prog, np.array([5], np.int32))
+    np.testing.assert_array_equal(out, [5])
+
+
+def test_staged_if_struct_cell_diagnostic():
+    """Assigning a struct variable inside a data-dependent if raises a
+    located staging error, not a bare TypeError from jnp (ADVICE r1)."""
+    import jax.numpy as jnp
+
+    from ziria_tpu.frontend import eval as E
+    from ziria_tpu.frontend.parser import Parser
+
+    src = "if c then { p := q } else { p := r }"
+    st = Parser(src, "<t>").parse_stmt()
+    scope = E.Scope()
+    sv = {"__struct__": "P", "a": 1}
+    scope.declare("p", dict(sv), None, mutable=True)
+    scope.declare("q", {"__struct__": "P", "a": 2}, None, mutable=False)
+    scope.declare("r", {"__struct__": "P", "a": 3}, None, mutable=False)
+    with pytest.raises(ZiriaRuntimeError, match="struct"):
+        E._staged_if(jnp.asarray(True), st, scope, E.Ctx())
+
+
+def test_staged_if_shape_mismatch_diagnostic():
+    import jax.numpy as jnp
+
+    from ziria_tpu.frontend import eval as E
+    from ziria_tpu.frontend.parser import Parser
+
+    src = "if c then { a := q } else { a := r }"
+    st = Parser(src, "<t>").parse_stmt()
+    scope = E.Scope()
+    scope.declare("a", np.zeros(2), None, mutable=True)
+    scope.declare("q", np.zeros(2), None, mutable=False)
+    scope.declare("r", np.zeros(3), None, mutable=False)
+    with pytest.raises(ZiriaRuntimeError, match="incompatible shapes"):
+        E._staged_if(jnp.asarray(True), st, scope, E.Ctx())

@@ -81,3 +81,15 @@ def test_parse_paths_agree_with_fallback(monkeypatch):
                         lambda *_: None)
     fallback = _parse_dbg(text, "int16")
     np.testing.assert_array_equal(native, fallback)
+
+
+def test_dbg_int_overflow_rejected():
+    """A literal beyond int64 must be reported as malformed, not wrap
+    via signed-overflow UB (ADVICE r1)."""
+    with pytest.raises(ValueError, match="malformed"):
+        native_lib.parse_dbg_ints_native("99999999999999999999999")
+    with pytest.raises(ValueError, match="malformed"):
+        native_lib.parse_dbg_ints_native("0xFFFFFFFFFFFFFFFFFF")
+    # INT64_MAX itself still parses
+    got = native_lib.parse_dbg_ints_native("9223372036854775807")
+    assert got[0] == 9223372036854775807

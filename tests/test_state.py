@@ -249,3 +249,27 @@ def test_resume_narrowing_within_kind_rejected():
     _, carry = run_jit_carry(prog, xs[:100])
     with pytest.raises(ValueError, match="losslessly"):
         run_jit_carry(prog, xs[100:].astype(np.int32), carry=carry)
+
+
+def test_fingerprint_mismatch_rejected(tmp_path):
+    """Same state layout, different program: the fingerprint must catch
+    it (ADVICE r1 — layout checks alone are not identity checks)."""
+    from ziria_tpu.runtime.state import program_fingerprint
+    import ziria_tpu as z
+
+    p1 = z.pipe(z.zmap(np.negative), z.zmap(np.abs))
+    p2 = z.pipe(z.zmap(np.negative), z.zmap(np.exp))
+    f1, f2 = program_fingerprint(p1), program_fingerprint(p2)
+    assert isinstance(f1, str) and len(f1) == 16
+    assert f1 != f2, "structurally different programs must differ"
+    assert f1 == program_fingerprint(
+        z.pipe(z.zmap(np.negative), z.zmap(np.abs)))
+
+    ck = tmp_path / "s.npz"
+    save_state(str(ck), {"stages": [], "leftover": np.empty(0)},
+               fingerprint="aaaabbbbccccdddd")
+    with pytest.raises(ValueError, match="different program"):
+        load_state(str(ck), [], fingerprint="0000111122223333")
+    # matching fingerprint (or none provided) loads fine
+    load_state(str(ck), [], fingerprint="aaaabbbbccccdddd")
+    load_state(str(ck), [])

@@ -11,10 +11,14 @@ Value representation / dtype policy:
 
   bit        Python int 0/1 (static) or jnp uint8
   bool       Python bool or jnp bool_
-  int{8,16,32,64}, int   jnp integer scalars (wrap-around = C semantics);
-             *literals and untyped lets stay Python ints* so that array
-             lengths, take counts and loop bounds remain static under
-             tracing
+  int{8,16,32,64}, int   jnp integer scalars. Arithmetic follows C:
+             int8/int16 operands promote to int32 before binops
+             (_promote_narrow_np), results narrow back to the declared
+             width only at assignment/cast; int32/int64 wrap at their
+             own width like C int/long long. *Literals and untyped lets
+             stay Python ints* so array lengths, take counts and loop
+             bounds remain static under tracing (unbounded until
+             assigned — diverges from C only past 2^63).
   double     float32 (TPU dtype policy — f64 would disable the MXU path;
              the golden-file differ absorbs the precision delta)
   complex{16,32}, complex  jnp complex64; `.re`/`.im` field access
@@ -329,6 +333,23 @@ _NP_BIT_OPS = {"&": np.bitwise_and, "|": np.bitwise_or,
                "^": np.bitwise_xor}
 
 
+_ARITH_PROMOTE = frozenset(("+", "-", "*", "/", "%", "**", "<<", ">>",
+                            "&", "|", "^"))
+
+
+def _promote_narrow_np(x: np.ndarray) -> np.ndarray:
+    """C integer promotion: int8/int16 operands widen to int32 before
+    arithmetic, so mid-expression results never wrap at the narrow
+    width (C semantics; ADVICE r1 medium). Narrowing back to the
+    declared width happens at assignment/cast via cast_value — exactly
+    where C truncates. int32/int64 wrap at their own width (= C int /
+    long long); static Python ints are unbounded until assigned, which
+    diverges from C only past 2^63."""
+    if x.dtype in (np.int8, np.int16):
+        return x.astype(np.int32)
+    return x
+
+
 def _binop(op: str, a: Any, b: Any, loc) -> Any:
     jnp = _jnp()
     both_static = is_static(a) and is_static(b)
@@ -363,6 +384,8 @@ def _binop(op: str, a: Any, b: Any, loc) -> Any:
     if _np_ok(a, b):
         # concrete numpy fast path — same semantics as the jnp branch
         an, bn = np.asarray(a), np.asarray(b)
+        if op in _ARITH_PROMOTE:
+            an, bn = _promote_narrow_np(an), _promote_narrow_np(bn)
         fn = _NP_OPS.get(op)
         if fn is not None:
             return fn(an, bn)
@@ -390,6 +413,12 @@ def _binop(op: str, a: Any, b: Any, loc) -> Any:
         raise _rt_err(loc, f"unknown operator {op!r}")
     from jax import lax
     aj, bj = jnp.asarray(a), jnp.asarray(b)
+    if op in _ARITH_PROMOTE:
+        # C integer promotion, traced path (see _promote_narrow_np)
+        if aj.dtype in (jnp.int8, jnp.int16):
+            aj = aj.astype(jnp.int32)
+        if bj.dtype in (jnp.int8, jnp.int16):
+            bj = bj.astype(jnp.int32)
     if op in ("+", "-", "*", "**"):
         return {"+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
                 "**": jnp.power}[op](aj, bj)
@@ -714,7 +743,19 @@ def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):
     for c, b, t, f in zip(cells, before, after_then, after_else):
         if t is b and f is b:
             continue
-        c.value = jnp.where(cond, jnp.asarray(t), jnp.asarray(f))
+        if isinstance(t, dict) or isinstance(f, dict):
+            raise _rt_err(
+                st.loc, "cannot stage an assignment to a struct variable "
+                        "inside a data-dependent if; assign to its "
+                        "scalar/array fields in both arms instead")
+        ta, fa = jnp.asarray(t), jnp.asarray(f)
+        if ta.shape != fa.shape:
+            raise _rt_err(
+                st.loc, f"data-dependent if assigns incompatible shapes "
+                        f"{ta.shape} vs {fa.shape} to the same variable; "
+                        f"under staging both arms must produce the same "
+                        f"shape (the merge is a jnp.where select)")
+        c.value = jnp.where(cond, ta, fa)
     return None
 
 

@@ -325,9 +325,11 @@ class Parser:
             kw = self.next().text
             args = self._call_args() if self.at_op("(") else self._bare_args()
             return A.SExpr(t.loc, A.ECall(t.loc, kw, args))
-        # assignment or expression statement
-        e = self._postfix(self._atom()) if self.peek().kind in ("id",) \
-            else self.parse_expr()
+        # assignment or expression statement: parse a full expression
+        # unconditionally — ':=' is not a binary operator, so parse_expr
+        # stops right before it, and non-assignment statements like
+        # `f(x) + g(y);` parse instead of erroring at the operator
+        e = self.parse_expr()
         if self.at_op(":="):
             self.next()
             if not isinstance(e, (A.EVar, A.EIdx, A.ESlice, A.EField)):

@@ -252,17 +252,21 @@ def main(argv=None) -> int:
         from ziria_tpu.backend.execute import lower, run_jit_carry
         carry = None
         if args.state_in:
-            from ziria_tpu.runtime.state import load_state
+            from ziria_tpu.runtime.state import (load_state,
+                                                 program_fingerprint)
             carry = load_state(args.state_in,
                                like=lower(comp, width=args.width)
-                               .init_carry)
+                               .init_carry,
+                               fingerprint=program_fingerprint(comp))
         stats: Optional[dict] = {} if args.stats else None
         ys, carry = run_jit_carry(comp, xs, carry=carry, width=args.width,
                                   stats_out=stats)
         ys = np.asarray(ys)
         if args.state_out:
-            from ziria_tpu.runtime.state import save_state
-            save_state(args.state_out, carry)
+            from ziria_tpu.runtime.state import (program_fingerprint,
+                                                 save_state)
+            save_state(args.state_out, carry,
+                       fingerprint=program_fingerprint(comp))
         if args.stats:
             # printed straight from the executor's own split arithmetic
             print(f"plan: width={stats['width']} take={stats['take']} "

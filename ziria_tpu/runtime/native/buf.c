@@ -89,6 +89,7 @@ int64_t ziria_parse_dbg_ints(const char *text, int64_t text_len,
                 else if (d >= 'a' && d <= 'f') hv = d - 'a' + 10;
                 else if (d >= 'A' && d <= 'F') hv = d - 'A' + 10;
                 else break;
+                if (v > (INT64_MAX - hv) / 16) return -1; /* overflow */
                 v = v * 16 + hv;
                 digits++;
                 i++;
@@ -96,7 +97,10 @@ int64_t ziria_parse_dbg_ints(const char *text, int64_t text_len,
             if (!digits) return -1;
         } else {
             while (i < text_len && text[i] >= '0' && text[i] <= '9') {
-                v = v * 10 + (text[i] - '0');
+                int d = text[i] - '0';
+                if (v > (INT64_MAX - d) / 10) return -1; /* overflow: a
+                    literal beyond int64 is a malformed stream, not UB */
+                v = v * 10 + d;
                 i++;
             }
         }

@@ -15,21 +15,57 @@ is flatten + save:
     ys2, carry = run_jit_carry(prog, second_half, carry=carry)
 
 `ys1 ++ ys2` equals the one-shot run for any split point (tested).
-The template (`like`) restores the stage pytree structure — obtained
-by lowering the same program, so a checkpoint is only loadable against
-the pipeline that wrote it; a structure, shape, or dtype mismatch is
-reported, not silently accepted.
+The template (`like`) restores the stage pytree structure; leaf
+count/shape/dtype mismatches are reported. Because two *different*
+programs can coincidentally share a state layout, callers may also
+pass ``fingerprint=program_fingerprint(comp)`` to both save and load —
+the checkpoint then records which program wrote it and a mismatch is
+an error (ADVICE r1: layout checks alone are not identity checks).
+The CLI does this for --state-in/--state-out.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import hashlib
+from typing import Any, Optional
 
 import jax
 import numpy as np
 
 
-def save_state(path: str, carry: Any) -> None:
+def program_fingerprint(comp: Any) -> str:
+    """A stable identity hash of a core-IR pipeline's *structure*:
+    node types, static counts/arities, bound names, and stage function
+    names — enough to distinguish two programs whose state pytrees
+    happen to have identical layouts."""
+    from ziria_tpu.core import ir
+
+    parts: list = []
+
+    def walk(x: Any) -> None:
+        parts.append(type(x).__name__)
+        d = getattr(x, "__dict__", None)
+        if d is None:
+            return
+        for k in sorted(d):
+            v = d[k]
+            if isinstance(v, ir.Comp):
+                parts.append(k)
+                walk(v)
+            elif isinstance(v, (list, tuple)):
+                for it in v:
+                    if isinstance(it, ir.Comp):
+                        walk(it)
+            elif isinstance(v, (str, int, bool)) or v is None:
+                parts.append(f"{k}={v!r}")
+            elif callable(v):
+                parts.append(f"{k}:{getattr(v, '__name__', 'fn')}")
+    walk(comp)
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def save_state(path: str, carry: Any,
+               fingerprint: Optional[str] = None) -> None:
     """Serialize a run_jit_carry carry (or bare stage pytree) to .npz."""
     if isinstance(carry, dict) and "stages" in carry:
         stages = carry["stages"]
@@ -38,17 +74,30 @@ def save_state(path: str, carry: Any) -> None:
         stages, leftover = carry, np.empty(0)
     leaves = jax.tree.leaves(stages)
     arrs = {f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)}
+    if fingerprint is not None:
+        arrs["fingerprint"] = np.asarray(fingerprint)
     np.savez(path, n_leaves=np.int64(len(leaves)), leftover=leftover,
              **arrs)
 
 
-def load_state(path: str, like: Any) -> Any:
+def load_state(path: str, like: Any,
+               fingerprint: Optional[str] = None) -> Any:
     """Load a carry saved by save_state, using `like` (the pipeline's
-    ``lower(comp).init_carry``) as the stage-structure template."""
+    ``lower(comp).init_carry``) as the stage-structure template. When
+    both the file and the caller provide a program fingerprint, they
+    must agree."""
     with np.load(path) as z:
         n = int(z["n_leaves"])
         leaves = [z[f"leaf{i}"] for i in range(n)]
         leftover = z["leftover"] if "leftover" in z else np.empty(0)
+        saved_fp = (str(z["fingerprint"]) if "fingerprint" in z
+                    else None)
+    if fingerprint is not None and saved_fp is not None \
+            and fingerprint != saved_fp:
+        raise ValueError(
+            f"checkpoint was written by a different program "
+            f"(fingerprint {saved_fp} != {fingerprint}); refusing to "
+            f"load it even though the state layout matches")
     template_leaves, treedef = jax.tree.flatten(like)
     if len(template_leaves) != n:
         raise ValueError(
