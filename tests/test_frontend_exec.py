@@ -505,9 +505,10 @@ def test_expression_statement_with_operator():
     np.testing.assert_array_equal(out, [5])
 
 
-def test_staged_if_struct_cell_diagnostic():
-    """Assigning a struct variable inside a data-dependent if raises a
-    located staging error, not a bare TypeError from jnp (ADVICE r1)."""
+def test_staged_if_struct_cell_merges_fieldwise():
+    """Assigning a struct variable inside a data-dependent if merges
+    per field with jnp.where (field assignment is copy-on-write, so
+    whole-dict replacement is the common case — ADVICE r1 follow-up)."""
     import jax.numpy as jnp
 
     from ziria_tpu.frontend import eval as E
@@ -516,10 +517,27 @@ def test_staged_if_struct_cell_diagnostic():
     src = "if c then { p := q } else { p := r }"
     st = Parser(src, "<t>").parse_stmt()
     scope = E.Scope()
-    sv = {"__struct__": "P", "a": 1}
-    scope.declare("p", dict(sv), None, mutable=True)
+    scope.declare("p", {"__struct__": "P", "a": 1}, None, mutable=True)
     scope.declare("q", {"__struct__": "P", "a": 2}, None, mutable=False)
     scope.declare("r", {"__struct__": "P", "a": 3}, None, mutable=False)
+    E._staged_if(jnp.asarray(True), st, scope, E.Ctx())
+    merged = scope.lookup("p")
+    assert merged["__struct__"] == "P"
+    assert int(np.asarray(merged["a"])) == 2
+
+
+def test_staged_if_struct_type_mismatch_diagnostic():
+    """One arm assigns a struct, the other a scalar: located error."""
+    import jax.numpy as jnp
+
+    from ziria_tpu.frontend import eval as E
+    from ziria_tpu.frontend.parser import Parser
+
+    src = "if c then { p := q } else { p := 5 }"
+    st = Parser(src, "<t>").parse_stmt()
+    scope = E.Scope()
+    scope.declare("p", {"__struct__": "P", "a": 1}, None, mutable=True)
+    scope.declare("q", {"__struct__": "P", "a": 2}, None, mutable=False)
     with pytest.raises(ZiriaRuntimeError, match="struct"):
         E._staged_if(jnp.asarray(True), st, scope, E.Ctx())
 

@@ -90,6 +90,8 @@ def test_dbg_int_overflow_rejected():
         native_lib.parse_dbg_ints_native("99999999999999999999999")
     with pytest.raises(ValueError, match="malformed"):
         native_lib.parse_dbg_ints_native("0xFFFFFFFFFFFFFFFFFF")
-    # INT64_MAX itself still parses
-    got = native_lib.parse_dbg_ints_native("9223372036854775807")
+    # INT64_MAX and INT64_MIN themselves still parse
+    got = native_lib.parse_dbg_ints_native(
+        "9223372036854775807,-9223372036854775808")
     assert got[0] == 9223372036854775807
+    assert got[1] == -9223372036854775808

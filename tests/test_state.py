@@ -264,6 +264,11 @@ def test_fingerprint_mismatch_rejected(tmp_path):
     assert f1 != f2, "structurally different programs must differ"
     assert f1 == program_fingerprint(
         z.pipe(z.zmap(np.negative), z.zmap(np.abs)))
+    # lambdas differing only in body must fingerprint differently
+    # (review r2: __name__ alone collapses every lambda to '<lambda>')
+    l1 = z.pipe(z.zmap(lambda x: x + 1))
+    l2 = z.pipe(z.zmap(lambda x: x * 2))
+    assert program_fingerprint(l1) != program_fingerprint(l2)
 
     ck = tmp_path / "s.npz"
     save_state(str(ck), {"stages": [], "leftover": np.empty(0)},

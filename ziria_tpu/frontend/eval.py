@@ -740,14 +740,20 @@ def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):
     if r1 is not None or r2 is not None:
         raise _rt_err(st.loc, "return inside a data-dependent if is not "
                               "supported under staging")
-    for c, b, t, f in zip(cells, before, after_then, after_else):
-        if t is b and f is b:
-            continue
+    def merge(t, f):
+        # struct cells merge field-wise (field assignment is
+        # copy-on-write, so whole-dict replacement is the normal case
+        # even for `p.a := x`)
         if isinstance(t, dict) or isinstance(f, dict):
-            raise _rt_err(
-                st.loc, "cannot stage an assignment to a struct variable "
-                        "inside a data-dependent if; assign to its "
-                        "scalar/array fields in both arms instead")
+            if not (isinstance(t, dict) and isinstance(f, dict)
+                    and set(t) == set(f)):
+                raise _rt_err(
+                    st.loc, "data-dependent if assigns a struct in one "
+                            "arm but not the other (or structs of "
+                            "different types); both arms must leave the "
+                            "variable with the same struct type")
+            return {k: (t[k] if k == "__struct__" else merge(t[k], f[k]))
+                    for k in t}
         ta, fa = jnp.asarray(t), jnp.asarray(f)
         if ta.shape != fa.shape:
             raise _rt_err(
@@ -755,7 +761,12 @@ def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):
                         f"{ta.shape} vs {fa.shape} to the same variable; "
                         f"under staging both arms must produce the same "
                         f"shape (the merge is a jnp.where select)")
-        c.value = jnp.where(cond, ta, fa)
+        return jnp.where(cond, ta, fa)
+
+    for c, b, t, f in zip(cells, before, after_then, after_else):
+        if t is b and f is b:
+            continue
+        c.value = merge(t, f)
     return None
 
 

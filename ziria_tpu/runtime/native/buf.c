@@ -77,19 +77,23 @@ int64_t ziria_parse_dbg_ints(const char *text, int64_t text_len,
             c = text[i];
         }
         if (c < '0' || c > '9') return -1;
-        int64_t v = 0;
+        /* accumulate the magnitude unsigned so overflow is detected
+         * without UB, and INT64_MIN (magnitude 2^63, one past
+         * INT64_MAX) still parses when negated */
+        uint64_t v = 0;
+        uint64_t lim = neg ? (uint64_t)INT64_MAX + 1u : (uint64_t)INT64_MAX;
         if (c == '0' && i + 1 < text_len &&
             (text[i + 1] == 'x' || text[i + 1] == 'X')) {
             i += 2;
             int digits = 0;
             while (i < text_len) {
                 char d = text[i];
-                int hv;
-                if (d >= '0' && d <= '9') hv = d - '0';
-                else if (d >= 'a' && d <= 'f') hv = d - 'a' + 10;
-                else if (d >= 'A' && d <= 'F') hv = d - 'A' + 10;
+                unsigned hv;
+                if (d >= '0' && d <= '9') hv = (unsigned)(d - '0');
+                else if (d >= 'a' && d <= 'f') hv = (unsigned)(d - 'a' + 10);
+                else if (d >= 'A' && d <= 'F') hv = (unsigned)(d - 'A' + 10);
                 else break;
-                if (v > (INT64_MAX - hv) / 16) return -1; /* overflow */
+                if (v > (lim - hv) / 16) return -1; /* overflow */
                 v = v * 16 + hv;
                 digits++;
                 i++;
@@ -97,14 +101,14 @@ int64_t ziria_parse_dbg_ints(const char *text, int64_t text_len,
             if (!digits) return -1;
         } else {
             while (i < text_len && text[i] >= '0' && text[i] <= '9') {
-                int d = text[i] - '0';
-                if (v > (INT64_MAX - d) / 10) return -1; /* overflow: a
-                    literal beyond int64 is a malformed stream, not UB */
+                unsigned d = (unsigned)(text[i] - '0');
+                if (v > (lim - d) / 10) return -1; /* overflow: a
+                    literal beyond int64 is a malformed stream */
                 v = v * 10 + d;
                 i++;
             }
         }
-        out[n++] = neg ? -v : v;
+        out[n++] = neg ? (int64_t)(0u - v) : (int64_t)v;
     }
     return n;
 }

@@ -34,32 +34,77 @@ import numpy as np
 
 
 def program_fingerprint(comp: Any) -> str:
-    """A stable identity hash of a core-IR pipeline's *structure*:
-    node types, static counts/arities, bound names, and stage function
-    names — enough to distinguish two programs whose state pytrees
-    happen to have identical layouts."""
+    """A stable identity hash of a core-IR pipeline: node types, static
+    counts/arities, bound names, stage function *code* and captured
+    constants — enough to distinguish two programs whose state pytrees
+    happen to have identical layouts, including two `zmap(lambda ...)`
+    pipelines whose lambdas differ only in body.
+
+    Deliberately excludes anything process-dependent (object addresses,
+    dict order): the fingerprint must match across interpreter restarts
+    or checkpoints would never load."""
     from ziria_tpu.core import ir
 
     parts: list = []
 
-    def walk(x: Any) -> None:
+    def add_callable(fn: Any, depth: int) -> None:
+        code = getattr(fn, "__code__", None)
+        parts.append(getattr(fn, "__qualname__",
+                             getattr(fn, "__name__", "fn")))
+        if code is None or depth > 6:
+            return
+        parts.append(hashlib.sha256(code.co_code).hexdigest()[:12])
+        for const in code.co_consts:
+            if isinstance(const, (int, float, bool, str, bytes)) \
+                    or const is None:
+                parts.append(repr(const))
+        # captured cells carry the distinguishing data for the shared
+        # elab closures (the `run` functions all have identical co_code;
+        # the AST lives in their cells)
+        for cell in (fn.__closure__ or ()):
+            try:
+                add_value(cell.cell_contents, depth + 1)
+            except ValueError:
+                pass
+        for dflt in (fn.__defaults__ or ()):
+            add_value(dflt, depth + 1)
+
+    def add_value(v: Any, depth: int) -> None:
+        if depth > 6:
+            return
+        if isinstance(v, ir.Comp):
+            walk(v, depth)
+        elif isinstance(v, (str, int, bool, float)) or v is None:
+            parts.append(repr(v))
+        elif isinstance(v, (list, tuple)):
+            for it in v[:64]:
+                add_value(it, depth + 1)
+        elif callable(v):
+            add_callable(v, depth)
+        elif hasattr(v, "dtype"):
+            a = np.asarray(v)
+            parts.append(f"arr{a.shape}{a.dtype}")
+            if a.size <= 4096:
+                parts.append(hashlib.sha256(
+                    np.ascontiguousarray(a).tobytes()).hexdigest()[:12])
+        elif type(v).__module__.startswith("ziria_tpu"):
+            # AST / IR dataclasses: frozen plain-data nodes whose repr
+            # is deterministic — but guard against default object reprs,
+            # whose addresses would make the fingerprint process-local
+            r = repr(v)
+            if " at 0x" not in r:
+                parts.append(r[:4096])
+            else:
+                parts.append(type(v).__name__)
+
+    def walk(x: Any, depth: int = 0) -> None:
         parts.append(type(x).__name__)
         d = getattr(x, "__dict__", None)
-        if d is None:
+        if d is None or depth > 12:
             return
         for k in sorted(d):
-            v = d[k]
-            if isinstance(v, ir.Comp):
-                parts.append(k)
-                walk(v)
-            elif isinstance(v, (list, tuple)):
-                for it in v:
-                    if isinstance(it, ir.Comp):
-                        walk(it)
-            elif isinstance(v, (str, int, bool)) or v is None:
-                parts.append(f"{k}={v!r}")
-            elif callable(v):
-                parts.append(f"{k}:{getattr(v, '__name__', 'fn')}")
+            parts.append(k)
+            add_value(d[k], depth + 1)
     walk(comp)
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
