@@ -250,22 +250,56 @@ def _child_main():
     assert np.array_equal(got_b[0], want) and np.array_equal(got_b[-1], want)
     note("batched correctness gate passed; timing")
 
-    t_tpu = _time(decode, frames, reps=50)
-    # fence-skew diagnostic: same timing with block_until_ready only.
-    t_bur = _time(decode, frames, reps=50,
-                  fence=lambda o: jax.block_until_ready(o))
+    # Steady-state throughput, amortized ON DEVICE. Measured r2: the
+    # axon tunnel costs ~70 ms per host round-trip and ~2-4 ms per
+    # queued call (50 queued 4k matmuls time at 14 TFLOP/s; a device-
+    # side chain of the same matmul runs at 213 TFLOP/s ~ peak), so
+    # per-call timing measures the tunnel, not the chip. A streaming
+    # receiver runs the decode in a device-side loop anyway, so the
+    # honest samples/sec/chip is the *marginal* time of one decode step
+    # inside a jitted fori_loop, taken between two loop lengths to
+    # cancel the fixed round-trip.
+    @jax.jit
+    def decode_k(f, k):
+        # traced loop bound -> ONE compile serves every K
+        def body(i, carry):
+            s, acc = carry
+            x = f + s * 1e-30            # loop-carried: no hoisting
+            bits = rx.decode_data_batch(x, rate, n_sym, n_psdu_bits)[0]
+            return (bits.astype(jnp.float32).sum() * 1e-30,
+                    acc + bits[0, 0].astype(jnp.int32))
+        return jax.lax.fori_loop(
+            0, k, body, (jnp.float32(0), jnp.int32(0)))[1]
+
+    def timed_k(k, tries=3):
+        best = float("inf")
+        _block(decode_k(frames, jnp.int32(k)))      # compile + warm
+        for _ in range(tries):
+            t0 = time.perf_counter()
+            _block(decode_k(frames, jnp.int32(k)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    K1, K2 = 32, 160
+    t1, t2 = timed_k(K1), timed_k(K2)
+    t_tpu = (t2 - t1) / (K2 - K1)
+    note(f"device-loop: K={K1}: {t1*1e3:.1f} ms, K={K2}: {t2*1e3:.1f} ms"
+         f" -> marginal {t_tpu*1e3:.3f} ms/step")
+
+    # per-call diagnostic (tunnel-dispatch-bound upper bound on latency)
+    t_percall = _time(decode, frames, reps=50)
     sps = B * frame_len / t_tpu
-    note(f"t_copy_fence={t_tpu*1e3:.3f} ms t_block_until_ready="
-         f"{t_bur*1e3:.3f} ms")
+    note(f"t_marginal={t_tpu*1e3:.3f} ms t_percall={t_percall*1e3:.3f} ms")
 
     out = {
         "tpu_sps": sps,
         "t_step_s": t_tpu,
+        "t_percall_s": t_percall,
+        "timing_method": f"marginal device-loop step (K={K1} vs {K2})",
         "batch": B,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
         "pallas_mosaic": pallas_mosaic,
-        "fence_skew": round(t_bur / t_tpu, 3),
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
     }
     print(json.dumps(out), flush=True)
@@ -375,7 +409,8 @@ def main():
         result["value"] = round(child["tpu_sps"], 1)
         result["vs_baseline"] = round(child["tpu_sps"] / sps_np, 3)
         for k in ("platform", "device_kind", "batch", "t_step_s",
-                  "pallas_mosaic", "fence_skew", "roofline"):
+                  "t_percall_s", "timing_method", "pallas_mosaic",
+                  "roofline"):
             result[k] = child.get(k)
     else:
         # TPU unreachable: record the baseline so the round has data.

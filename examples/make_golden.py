@@ -34,6 +34,11 @@ def _iq(n, seed):
         -600, 600, (n, 2)).astype(np.int16)
 
 
+def _llrs(n, seed):
+    return (4.0 * np.random.default_rng(seed).standard_normal(n)) \
+        .astype(np.float32)
+
+
 CASES = [
     ("scrambler", "bit", lambda: _bits(512, 100), "dbg"),
     ("fir", "int32",
@@ -44,6 +49,21 @@ CASES = [
     ("lut_map", "int8",
      lambda: np.arange(-128, 128, dtype=np.int8), "dbg"),
     ("qam16", "bit", lambda: _bits(64 * 4, 104), "dbg"),
+    # RX-side per-block corpus (VERDICT r1 #7): demap at all four
+    # constellations, soft deinterleave, depuncture, pilot tracking —
+    # the reference's densest golden-test area (SURVEY.md §2.3)
+    ("demap_bpsk", "complex16", lambda: _iq(256, 105), "dbg"),
+    ("demap_qpsk", "complex16", lambda: _iq(256, 106), "dbg"),
+    ("demap_qam16", "complex16", lambda: _iq(256, 107), "dbg"),
+    ("demap_qam64", "complex16", lambda: _iq(256, 108), "bin"),
+    ("deinterleave_bpsk", "bit", lambda: _bits(480, 109), "dbg"),
+    ("deinterleave_qam16", "float32", lambda: _llrs(192 * 4, 110), "dbg"),
+    ("depuncture_23", "float32", lambda: _llrs(192, 111), "dbg"),
+    ("depuncture_34", "float32", lambda: _llrs(192, 112), "bin"),
+    ("pilot_track", "complex16", lambda: _iq(52 * 6, 113), "dbg"),
+    # stdlib (v_* / crc32) examples — VERDICT r1 #8
+    ("crc_frame", "bit", lambda: _bits(512, 114), "bin"),
+    ("correlator", "complex16", lambda: _iq(320, 115), "dbg"),
 ]
 
 

@@ -35,8 +35,11 @@ def _generator_cases():
 
 _MODES = _generator_cases()
 
-# quantized complex streams compare with atol=1; everything else exact
-_ATOL = {"fft64": 1.0, "qam16": 1.0}
+# quantized complex streams compare with atol=1; float LLR outputs
+# tolerate interp-f64 vs jit-f32 rounding; everything else exact
+_ATOL = {"fft64": 1.0, "qam16": 1.0, "pilot_track": 1.0,
+         "demap_bpsk": 1e-4, "demap_qpsk": 1e-4,
+         "demap_qam16": 1e-4, "demap_qam64": 1e-4}
 
 CASES = [(name, mode, _ATOL.get(name, 0.0))
          for name, mode in _MODES.items()]

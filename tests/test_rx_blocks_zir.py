@@ -1,0 +1,114 @@
+"""RX per-block surface programs vs the ops/ oracles.
+
+The golden files (examples/golden/) pin interp == jit on fixed inputs;
+these tests pin the *semantics*: each .zir RX block must match the
+corresponding ziria_tpu/ops implementation the receiver actually uses
+(VERDICT r1 #7 — the reference's densest per-block test area,
+SURVEY.md §2.3)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ziria_tpu.frontend import compile_file
+from ziria_tpu.interp.interp import run
+from ziria_tpu.ops import coding, demap as demap_mod, interleave
+from ziria_tpu.utils.diff import assert_stream_eq
+
+HERE = os.path.dirname(__file__)
+EXAMPLES = os.path.abspath(os.path.join(HERE, "..", "examples"))
+
+RNG = np.random.default_rng(42)
+
+
+def _run_zir(name, xs):
+    prog = compile_file(os.path.join(EXAMPLES, f"{name}.zir"))
+    res = run(prog.comp, list(xs))
+    return np.asarray(res.out_array())
+
+
+def _iq(n):
+    return RNG.integers(-600, 600, (n, 2)).astype(np.int16)
+
+
+@pytest.mark.parametrize("name,n_bpsc", [
+    ("demap_bpsk", 1), ("demap_qpsk", 2),
+    ("demap_qam16", 4), ("demap_qam64", 6),
+])
+def test_demap_blocks_match_ops(name, n_bpsc):
+    iq = _iq(96)
+    got = _run_zir(name, iq)
+    want = np.asarray(demap_mod.demap(iq.astype(np.float32) / 512.0,
+                                      n_bpsc))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_deinterleave_bpsk_matches_ops():
+    bits = RNG.integers(0, 2, 480).astype(np.uint8)
+    got = _run_zir("deinterleave_bpsk", bits)
+    want = np.asarray(interleave.deinterleave(bits, 48, 1))
+    assert_stream_eq(got, want, name="deint48")
+    # and it inverts the TX interleaver block
+    inter = _run_zir("interleaver", bits)
+    back = _run_zir("deinterleave_bpsk", inter.astype(np.uint8))
+    assert_stream_eq(back, bits, name="roundtrip48")
+
+
+def test_deinterleave_qam16_matches_ops():
+    llrs = RNG.standard_normal(192 * 3).astype(np.float32)
+    got = _run_zir("deinterleave_qam16", llrs)
+    want = np.asarray(interleave.deinterleave(llrs, 192, 4))
+    np.testing.assert_allclose(got, want, atol=0)
+
+
+@pytest.mark.parametrize("name,rate", [
+    ("depuncture_23", "2/3"), ("depuncture_34", "3/4"),
+])
+def test_depuncture_blocks_match_ops(name, rate):
+    llrs = RNG.standard_normal(96).astype(np.float32)
+    got = _run_zir(name, llrs)
+    want = np.asarray(coding.depuncture(llrs, rate, fill=0.0)).reshape(-1)
+    np.testing.assert_allclose(got, want, atol=0)
+
+
+def test_pilot_track_matches_rx_oracle():
+    """The in-language pilot tracker == rx.pilot_phase_correct on the
+    same (data, pilots) layout, up to the int16 requantization."""
+    from ziria_tpu.phy.wifi.rx import pilot_phase_correct
+
+    n_sym = 5
+    iq = _iq(52 * n_sym)
+    got = _run_zir("pilot_track", iq).reshape(n_sym, 48, 2)
+
+    sym = iq.astype(np.float32).reshape(n_sym, 52, 2) / 1.0
+    data = sym[:, :48]
+    pilots = sym[:, 48:]
+    want = np.asarray(pilot_phase_correct(data, pilots, symbol_index0=0))
+    np.testing.assert_allclose(got, np.round(want), atol=1.0)
+
+
+def test_crc_frame_matches_ops():
+    """The crc32 stdlib external through a .zir program == ops/crc.py
+    append_crc32 per frame."""
+    from ziria_tpu.ops.crc import append_crc32
+
+    bits = RNG.integers(0, 2, 512).astype(np.uint8)
+    got = _run_zir("crc_frame", bits)
+    want = np.concatenate([np.asarray(append_crc32(bits[:256])),
+                           np.asarray(append_crc32(bits[256:]))])
+    assert_stream_eq(got, want, name="crc_frame")
+
+
+def test_correlator_matches_numpy():
+    """The v_conj_mul + v_sum_window detector block == direct numpy."""
+    iq = _iq(320)
+    got = _run_zir("correlator", iq)
+    x = (iq[:, 0] + 1j * iq[:, 1]).astype(np.complex64)
+    want = []
+    for blk in (x[:160], x[160:]):
+        m = blk[16:160] * np.conj(blk[0:144])
+        s = np.array([m[k:k + 16].sum() for k in range(129)])
+        want.append(np.abs(s) / (512.0 * 512.0))
+    np.testing.assert_allclose(got, np.concatenate(want), rtol=2e-5,
+                               atol=1e-4)

@@ -746,7 +746,8 @@ def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):
         # even for `p.a := x`)
         if isinstance(t, dict) or isinstance(f, dict):
             if not (isinstance(t, dict) and isinstance(f, dict)
-                    and set(t) == set(f)):
+                    and set(t) == set(f)
+                    and t.get("__struct__") == f.get("__struct__")):
                 raise _rt_err(
                     st.loc, "data-dependent if assigns a struct in one "
                             "arm but not the other (or structs of "

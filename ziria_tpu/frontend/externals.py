@@ -76,6 +76,86 @@ BUILTINS: Dict[str, Callable] = {
     "sum": _sum,
 }
 
+def _v_binop(op_name: str) -> Callable:
+    def wrapper(a, b):
+        xp = _xp((a, b))
+        return getattr(xp, op_name)(xp.asarray(a), xp.asarray(b))
+    wrapper.__name__ = f"v_{op_name}"
+    return wrapper
+
+
+def _v_shift_right(x, n):
+    """Arithmetic right shift of an integer vector — the reference
+    `v_shift_right` brick's role (post-multiply renormalization in
+    fixed-point chains)."""
+    xp = _xp((x, n))
+    return xp.right_shift(xp.asarray(x), xp.asarray(n))
+
+
+def _v_shift_left(x, n):
+    xp = _xp((x, n))
+    return xp.left_shift(xp.asarray(x), xp.asarray(n))
+
+
+def _v_conj_mul(a, b):
+    """a * conj(b) elementwise on complex vectors — the correlation
+    inner step (reference `v_conj_mul`/`v_mul` pair)."""
+    xp = _xp((a, b))
+    return xp.asarray(a) * xp.conj(xp.asarray(b))
+
+
+def _v_correlate(x, ref):
+    """Sliding cross-correlation of complex `x` against pattern `ref`
+    at all full-overlap lags: out[k] = sum_j x[k+j] * conj(ref[j]).
+    Reference's correlation brick; out length = len(x) - len(ref) + 1."""
+    xp = _xp((x, ref))
+    xa = xp.asarray(x)
+    ra = xp.conj(xp.asarray(ref))[::-1]
+    return xp.convolve(xa, ra, mode="valid")
+
+
+def _v_downsample(x, k):
+    xp = _xp((x,))
+    return xp.asarray(x)[:: int(k)]
+
+
+def _v_sum_window(x, w):
+    """Sliding window sum over `w` samples (moving average * w): the
+    packet-detect energy window. out[k] = sum x[k:k+w]."""
+    xp = _xp((x,))
+    xa = xp.asarray(x)
+    c = xp.cumsum(xp.concatenate([xp.zeros(1, xa.dtype), xa]))
+    return c[int(w):] - c[: c.shape[0] - int(w)]
+
+
+def _crc32(bits):
+    """802.11 FCS over a bit stream -> 32 CRC bits (transmit order).
+    Binds ops/crc.py (the reference's crc.blk role, SURVEY.md §2.3)."""
+    from ziria_tpu.ops.crc import crc32_bits, np_crc32_bits_ref
+    if _xp((bits,)) is np:
+        return np_crc32_bits_ref(np.asarray(bits, np.uint8))
+    return crc32_bits(bits)
+
+
+def _bits_to_int8(bits):
+    """8 LSB-first bits -> one byte value (reference bit.c role)."""
+    from ziria_tpu.utils.bits import bits_to_bytes
+    xp = _xp((bits,))
+    if xp is np:
+        from ziria_tpu.utils.bits import np_bits_to_bytes
+        return np_bits_to_bytes(np.asarray(bits, np.uint8)).astype(np.int8)
+    return bits_to_bytes(bits).astype(_jnp().int8)
+
+
+def _int8_to_bits(v):
+    from ziria_tpu.utils.bits import bytes_to_bits
+    xp = _xp((v,))
+    if xp is np:
+        from ziria_tpu.utils.bits import np_bytes_to_bits
+        return np_bytes_to_bits(np.asarray(v, np.uint8).reshape(-1))
+    return bytes_to_bits(_jnp().asarray(v).astype(_jnp().uint8).reshape(-1))
+
+
 # available via `ext fun` declaration (names mirror the reference's lib/)
 EXTERNALS: Dict[str, Callable] = {
     "sqrt": _f("sqrt"),
@@ -96,6 +176,19 @@ EXTERNALS: Dict[str, Callable] = {
     "v_ifft": _ifft,
     "fft": _fft,
     "ifft": _ifft,
+    "v_add": _v_binop("add"),
+    "v_sub": _v_binop("subtract"),
+    "v_mul": _v_binop("multiply"),
+    "v_conj_mul": _v_conj_mul,
+    "v_shift_right": _v_shift_right,
+    "v_shift_left": _v_shift_left,
+    "v_correlate": _v_correlate,
+    "v_downsample": _v_downsample,
+    "v_sum_window": _v_sum_window,
+    # bit/byte + CRC utilities (reference bit.c / crc.blk roles)
+    "crc32": _crc32,
+    "bits_to_int8": _bits_to_int8,
+    "int8_to_bits": _int8_to_bits,
 }
 
 

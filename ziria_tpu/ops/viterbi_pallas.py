@@ -67,11 +67,19 @@ def _branch_coeffs():
     return a0, a1, b0, b1
 
 
-def _acs_kernel(llr_ref, dec_ref, metrics_out_ref, m_ref):
-    """One trellis time-step for one batch tile.
+# trellis steps processed per grid step: the per-step ACS is ~15 vector
+# ops on (64, 128) tiles — far too little work to amortize a Mosaic grid
+# step, which made the r1 kernel grid-overhead-bound (measured 4.6 ms
+# for T=8208 at B=128). Unrolling K steps into one kernel body cuts the
+# grid by K at ~K x program size.
+UNROLL = 64
 
-    llr_ref: (1, 2, 128) this step's (A, B) soft inputs per lane.
-    dec_ref: (1, 8, 128) uint8 packed decision plane out (this step):
+
+def _acs_kernel(llr_ref, dec_ref, metrics_out_ref, m_ref):
+    """UNROLL trellis time-steps for one batch tile.
+
+    llr_ref: (1, UNROLL, 2, 128) this block's (A, B) soft inputs/lane.
+    dec_ref: (1, UNROLL, 8, 128) uint8 packed decision planes out:
       byte i, bit j holds the survivor bit of state 8*i + j.
     metrics_out_ref: (64, 128) f32 — final metrics (last write wins).
     m_ref: (64, 128) f32 VMEM scratch — path metrics across the sweep.
@@ -83,47 +91,59 @@ def _acs_kernel(llr_ref, dec_ref, metrics_out_ref, m_ref):
         rows = jax.lax.broadcasted_iota(jnp.int32, (N_STATES, LANES), 0)
         m_ref[:] = jnp.where(rows == 0, 0.0, _NEG).astype(jnp.float32)
 
-    la = llr_ref[0, 0, 0:1, :]                    # (1, 128)
-    lb = llr_ref[0, 0, 1:2, :]
+    a0, a1, b0, b1 = _branch_coeffs()
+    # bit-packing as ONE MXU matmul per step: sel[i, s] is
+    # (1 << (s & 7)) when s lives in byte i (s >> 3 == i), else 0, so
+    # sel @ dec gives byte i = sum_j dec[8i+j] << j exactly (all values
+    # are small ints, exact in f32). Replaces 64 row-slice VPU ops per
+    # step — the kernel is issue-bound, not FLOP-bound.
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (8, N_STATES), 1)
+    b_idx = jax.lax.broadcasted_iota(jnp.int32, (8, N_STATES), 0)
+    sel = jnp.where((s_idx >> 3) == b_idx,
+                    (1 << (s_idx & 7)).astype(jnp.float32), 0.0)
 
     m = m_ref[:]                                  # (64, 128)
-    pairs = m.reshape(32, 2, LANES)
-    ev = jnp.concatenate([pairs[:, 0, :]] * 2, axis=0)   # pred d=0, (64,128)
-    od = jnp.concatenate([pairs[:, 1, :]] * 2, axis=0)   # pred d=1
+    for j in range(UNROLL):
+        la = llr_ref[0, j, 0:1, :]                # (1, 128)
+        lb = llr_ref[0, j, 1:2, :]
 
-    a0, a1, b0, b1 = _branch_coeffs()
-    cand0 = ev + a0 * la + b0 * lb
-    cand1 = od + a1 * la + b1 * lb
+        pairs = m.reshape(32, 2, LANES)
+        ev = jnp.concatenate([pairs[:, 0, :]] * 2, axis=0)  # pred d=0
+        od = jnp.concatenate([pairs[:, 1, :]] * 2, axis=0)  # pred d=1
 
-    dec = cand1 > cand0
-    new = jnp.maximum(cand0, cand1)
-    new = new - jnp.max(new, axis=0, keepdims=True)      # per-lane renorm
+        cand0 = ev + a0 * la + b0 * lb
+        cand1 = od + a1 * la + b1 * lb
 
-    m_ref[:] = new
-    metrics_out_ref[0] = new
-    # pack 8 consecutive states per byte: byte i bit j = dec[8i + j].
-    # Formulated as contiguous single-row slices + shifts + concat (the
-    # most conservative Mosaic ops — no sublane-splitting reshape, no
-    # strided slice); unrolls to 64 cheap VPU adds.
-    d32 = dec.astype(jnp.int32)                          # (64, 128)
-    rows = []
-    for i in range(8):
-        acc = d32[8 * i: 8 * i + 1]
-        for j in range(1, 8):
-            acc = acc + (d32[8 * i + j: 8 * i + j + 1] << j)
-        rows.append(acc)
-    dec_ref[0, 0] = jnp.concatenate(rows, axis=0).astype(jnp.uint8)
+        dec = cand1 > cand0
+        m = jnp.maximum(cand0, cand1)
+
+        packed = jax.lax.dot(sel, dec.astype(jnp.float32),
+                             precision=jax.lax.Precision.HIGHEST)
+        # Mosaic has no f32->u8 cast; round-trip through int32
+        dec_ref[0, j] = packed.astype(jnp.int32).astype(jnp.uint8)
+    # renorm once per block, not per step: decisions depend only on
+    # metric *differences*, and metrics drift by at most
+    # UNROLL * max|llr| between renorms — far inside f32 range
+    m = m - jnp.max(m, axis=0, keepdims=True)
+    m_ref[:] = m
+
+    @pl.when(t == pl.num_programs(1) - 1)
+    def _flush():
+        metrics_out_ref[0] = m_ref[:]
 
 
 def _traceback_kernel(dec_ref, metrics_ref, bits_ref, s_ref):
-    """One backward step: select the survivor decision at the current
-    state (one-hot sum — no per-lane gather), emit the decoded bit, move
-    to the predecessor.
+    """UNROLL backward steps: select the survivor decision at the
+    current state (one-hot sum — no per-lane gather), emit the decoded
+    bit, move to the predecessor.
 
-    dec_ref: (1, 8, 128) packed decision plane for trellis step T-1-t.
+    dec_ref: (1, UNROLL, 8, 128) packed decision planes for trellis
+      steps [T-(t+1)*UNROLL, T-t*UNROLL), walked in reverse within the
+      block.
     metrics_ref: (64, 128) final path metrics (used only at t == 0).
-    bits_ref: (1, 8, 128) int32 out — decoded bit plane, row 0 carries it
-      (8 sublanes keeps the store tile-aligned).
+    bits_ref: (1, UNROLL, 8, 128) int32 out — decoded bit planes, row 0
+      of each (8, 128) plane carries it (8 sublanes keeps the store
+      tile-aligned).
     s_ref: (8, 128) int32 scratch — row 0 is the current state per lane.
     """
     t = pl.program_id(1)
@@ -133,18 +153,17 @@ def _traceback_kernel(dec_ref, metrics_ref, bits_ref, s_ref):
         end = jnp.argmax(metrics_ref[0], axis=0).astype(jnp.int32)  # (128,)
         s_ref[:] = jnp.broadcast_to(end[None, :], (8, LANES))
 
-    state = s_ref[0:1, :]                              # (1, 128)
-    packed = dec_ref[0, 0].astype(jnp.int32)           # (8, 128)
     rows = jax.lax.broadcasted_iota(jnp.int32, (8, LANES), 0)
-    onehot = (rows == (state >> 3)).astype(jnp.int32)  # select byte row
-    byte = jnp.sum(packed * onehot, axis=0, keepdims=True)   # (1, 128)
-    d = (byte >> (state & 7)) & 1                      # unpack bit
+    state = s_ref[0:1, :]                              # (1, 128)
+    for j in reversed(range(UNROLL)):
+        packed = dec_ref[0, j].astype(jnp.int32)       # (8, 128)
+        onehot = (rows == (state >> 3)).astype(jnp.int32)  # byte row
+        byte = jnp.sum(packed * onehot, axis=0, keepdims=True)  # (1,128)
+        d = (byte >> (state & 7)) & 1                  # unpack bit
 
-    bit = state >> 5
-    prev = ((state & 31) << 1) | d
-
-    s_ref[0:1, :] = prev
-    bits_ref[0, 0] = jnp.broadcast_to(bit, (8, LANES))
+        bits_ref[0, j] = jnp.broadcast_to(state >> 5, (8, LANES))
+        state = ((state & 31) << 1) | d
+    s_ref[0:1, :] = state
 
 
 def _interpret_default() -> bool:
@@ -158,39 +177,47 @@ def _interpret_default() -> bool:
 def _decode_tiles(llrs, interpret: bool):
     """(nb, T, 2, 128) f32 -> (nb, T, 128) uint8 decoded bit planes."""
     nb, T = llrs.shape[0], llrs.shape[1]
+    # pad the trellis to a multiple of UNROLL with zero LLRs (erasures:
+    # they add no likelihood, so the surviving path over the real prefix
+    # is unchanged); the garbage pad bits are sliced off below
+    Tp = -(-T // UNROLL) * UNROLL
+    if Tp != T:
+        llrs = jnp.pad(llrs, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
+    TB = Tp // UNROLL                       # grid blocks per trellis
 
     dec, metrics = pl.pallas_call(
         _acs_kernel,
-        grid=(nb, T),
-        in_specs=[pl.BlockSpec((1, 1, 2, LANES), lambda b, t: (b, t, 0, 0))],
+        grid=(nb, TB),
+        in_specs=[pl.BlockSpec((1, UNROLL, 2, LANES),
+                               lambda b, t: (b, t, 0, 0))],
         out_specs=[
-            pl.BlockSpec((1, 1, 8, LANES), lambda b, t: (b, t, 0, 0)),
+            pl.BlockSpec((1, UNROLL, 8, LANES), lambda b, t: (b, t, 0, 0)),
             pl.BlockSpec((1, N_STATES, LANES), lambda b, t: (b, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nb, T, 8, LANES), jnp.uint8),
+            jax.ShapeDtypeStruct((nb, Tp, 8, LANES), jnp.uint8),
             jax.ShapeDtypeStruct((nb, N_STATES, LANES), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((N_STATES, LANES), jnp.float32)],
         interpret=interpret,
-    )(llrs.reshape(nb, T, 2, LANES))
+    )(llrs)
 
     bits = pl.pallas_call(
         _traceback_kernel,
-        grid=(nb, T),
+        grid=(nb, TB),
         in_specs=[
-            pl.BlockSpec((1, 1, 8, LANES),
-                         lambda b, t, _T=T: (b, _T - 1 - t, 0, 0)),
+            pl.BlockSpec((1, UNROLL, 8, LANES),
+                         lambda b, t, _n=TB: (b, _n - 1 - t, 0, 0)),
             pl.BlockSpec((1, N_STATES, LANES), lambda b, t: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, 8, LANES),
-                               lambda b, t, _T=T: (b, _T - 1 - t, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, T, 8, LANES), jnp.int32),
+        out_specs=pl.BlockSpec((1, UNROLL, 8, LANES),
+                               lambda b, t, _n=TB: (b, _n - 1 - t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, Tp, 8, LANES), jnp.int32),
         scratch_shapes=[pltpu.VMEM((8, LANES), jnp.int32)],
         interpret=interpret,
     )(dec, metrics)
 
-    return bits[:, :, 0, :].astype(jnp.uint8)
+    return bits[:, :T, 0, :].astype(jnp.uint8)
 
 
 def viterbi_decode_batch(llrs, n_bits: int = None, interpret: bool = None):

@@ -159,6 +159,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stats", action="store_true",
                    help="print the fused plan: per-stage firing counts, "
                         "rates, width (jit backend)")
+    p.add_argument("--profile", action="store_true",
+                   help="per-stage wall time + item counts: each top-"
+                        "level pipeline stage runs separately (warm-up "
+                        "+ timed pass); totals differ from the fused run")
+    p.add_argument("--profile-trace", metavar="DIR",
+                   help="write a jax.profiler trace of the run to DIR "
+                        "(view with TensorBoard / xprof)")
     p.add_argument("--state-in",
                    help="resume stream state from this checkpoint "
                         "(runtime/state.py; jit backend)")
@@ -205,6 +212,48 @@ def _apply_platform(name: Optional[str]) -> None:
                   f"on {live}", file=sys.stderr)
 
 
+def _run_profiled(comp, xs, args):
+    """Per-stage observability (SURVEY.md §5 tracing row): run each
+    top-level pipeline stage separately — one warm-up pass (compile),
+    one timed pass — reporting wall time and item counts per stage.
+    Stages are composition-independent (their state is internal), so
+    the final output equals the fused run's; only the *timing* loses
+    cross-stage fusion, which is the point of a per-stage breakdown."""
+    from ziria_tpu.core.ir import pipeline_stages
+
+    stages = list(pipeline_stages(comp))
+    rows = []
+    cur = np.asarray(xs)
+    for st in stages:
+        if args.backend == "interp":
+            from ziria_tpu.interp.interp import run
+
+            def go(_st=st, _cur=cur):
+                return np.asarray(run(_st, list(_cur)).out_array())
+        else:
+            from ziria_tpu.backend.execute import run_jit_carry
+
+            def go(_st=st, _cur=cur):
+                ys, _ = run_jit_carry(_st, _cur, width=args.width)
+                return np.asarray(ys)
+
+        go()                                   # warm-up / compile
+        t0 = time.perf_counter()
+        out = go()
+        dt = time.perf_counter() - t0
+        rows.append((st.label(), cur.shape[0], out.shape[0], dt))
+        cur = out
+
+    total = sum(r[3] for r in rows) or 1e-12
+    print(f"profile: {len(rows)} stage(s), backend={args.backend} "
+          f"(stages timed unfused)", file=sys.stderr)
+    for lbl, n_in, n_out, dt in rows:
+        print(f"  stage {lbl:<28s} {n_in:>8d} -> {n_out:>8d} items  "
+              f"{dt * 1e3:>9.3f} ms  {100 * dt / total:>5.1f}%  "
+              f"({n_in / max(dt, 1e-12):,.0f} items/s)", file=sys.stderr)
+    return cur
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _apply_platform(args.platform)
@@ -239,8 +288,39 @@ def main(argv=None) -> int:
                           path=args.output_file_name,
                           mode=args.output_file_mode)
 
+    if args.profile and (args.state_in or args.state_out):
+        raise SystemExit("--profile runs stages separately and "
+                         "cannot combine with --state-in/--state-out")
     xs = read_stream(in_spec)
+    tracing = False
+    if args.profile_trace:
+        import jax
+        jax.profiler.start_trace(args.profile_trace)
+        tracing = True
     t0 = time.perf_counter()
+    try:
+        ys, dt = _run_backend(comp, xs, args, t0)
+    finally:
+        if tracing:
+            import jax
+            jax.profiler.stop_trace()
+            print(f"profiler trace written to {args.profile_trace}",
+                  file=sys.stderr)
+
+    write_stream(out_spec, ys)
+    if args.verbose:
+        print(f"items in: {xs.shape[0]}, items out: {ys.shape[0]}, "
+              f"time: {dt:.4f}s "
+              f"({xs.shape[0] / max(dt, 1e-12):,.0f} items/s)",
+              file=sys.stderr)
+    return 0
+
+
+def _run_backend(comp, xs, args, t0):
+    """Dispatch to --profile / interp / jit; returns (ys, seconds)."""
+    if args.profile:
+        ys = _run_profiled(comp, xs, args)
+        return ys, time.perf_counter() - t0
     if args.backend == "interp":
         if args.state_in or args.state_out:
             raise SystemExit("--state-in/--state-out need --backend=jit "
@@ -278,15 +358,7 @@ def main(argv=None) -> int:
                 print(f"  stage {lbl:<28s} {reps:>6d} firings/iter "
                       f"({reps * stats['width']} per bulk step)",
                       file=sys.stderr)
-    dt = time.perf_counter() - t0
-
-    write_stream(out_spec, ys)
-    if args.verbose:
-        print(f"items in: {xs.shape[0]}, items out: {ys.shape[0]}, "
-              f"time: {dt:.4f}s "
-              f"({xs.shape[0] / max(dt, 1e-12):,.0f} items/s)",
-              file=sys.stderr)
-    return 0
+    return ys, time.perf_counter() - t0
 
 
 if __name__ == "__main__":

@@ -84,9 +84,10 @@ def program_fingerprint(comp: Any) -> str:
         elif hasattr(v, "dtype"):
             a = np.asarray(v)
             parts.append(f"arr{a.shape}{a.dtype}")
-            if a.size <= 4096:
-                parts.append(hashlib.sha256(
-                    np.ascontiguousarray(a).tobytes()).hexdigest()[:12])
+            # content hash for EVERY captured array — a big LUT edited
+            # between runs must change the fingerprint too (review r2)
+            parts.append(hashlib.sha256(
+                np.ascontiguousarray(a).tobytes()).hexdigest()[:12])
         elif type(v).__module__.startswith("ziria_tpu"):
             # AST / IR dataclasses: frozen plain-data nodes whose repr
             # is deterministic — but guard against default object reprs,
