@@ -64,7 +64,14 @@ CASES = [
     # stdlib (v_* / crc32) examples — VERDICT r1 #8
     ("crc_frame", "bit", lambda: _bits(512, 114), "bin"),
     ("correlator", "complex16", lambda: _iq(320, 115), "dbg"),
+    # int16 fixed-point complex16 policy (VERDICT r1 #6): exact
+    # integer outputs for scrambler -> encoder -> modulator
+    ("tx_qpsk_fxp", "bit", lambda: _bits(384, 116), "bin"),
 ]
+
+# cases compiled under the fixed-point complex16 policy
+# (--fxp-complex16 on replay)
+FXP_CASES = {"tx_qpsk_fxp"}
 
 
 def main() -> None:
@@ -79,7 +86,7 @@ def main() -> None:
     os.makedirs(GOLD, exist_ok=True)
     for name, in_ty, make, mode in CASES:
         src = os.path.join(HERE, f"{name}.zir")
-        prog = compile_file(src)
+        prog = compile_file(src, fxp_complex16=name in FXP_CASES)
         xs = make()
         res = run(prog.comp, list(xs))
         ys = res.out_array()

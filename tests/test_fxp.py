@@ -1,0 +1,191 @@
+"""Int16 fixed-point complex16 policy (VERDICT r1 #6, SURVEY.md §7
+hard-part (b)): complex16 values are integer IQ pairs with C shorts
+semantics — int32 mid-expression, wrap to int16 at assignment/cast —
+and the TX chain's golden outputs are EXACT integers."""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.frontend import ZiriaRuntimeError, compile_source
+from ziria_tpu.interp.interp import run
+
+
+def run_fxp(src, xs, backend="interp"):
+    prog = compile_source(src, fxp_complex16=True)
+    if backend == "interp":
+        return np.asarray(run(prog.comp, list(xs)).out_array())
+    from ziria_tpu.backend.execute import run_jit
+    return np.asarray(run_jit(prog.comp, xs))
+
+
+MUL_SRC = """
+  let comp main = read[complex16] >>>
+    repeat {
+      x <- take;
+      var y : complex16 := complex16(0, 0);
+      do { y := x * x };
+      emit y
+    } >>> write[complex16]
+"""
+
+
+@pytest.mark.parametrize("backend", ["interp", "jit"])
+def test_fx_multiply_wraps_at_store(backend):
+    """(300 + 200j)^2 = 50000 + 120000j in int32; storing to complex16
+    wraps each component to int16: 50000 -> -15536, 120000 -> -11072."""
+    iq = np.array([[300, 200], [1, 2], [-5, 7]], np.int16)
+    out = run_fxp(MUL_SRC, iq, backend)
+    want = []
+    for re, im in iq.astype(np.int64):
+        wre = (re * re - im * im)
+        wim = (2 * re * im)
+        wrap = lambda v: ((int(v) + 2**15) % 2**16) - 2**15  # noqa: E731
+        want.append([wrap(wre), wrap(wim)])
+    np.testing.assert_array_equal(out, np.asarray(want, np.int16))
+
+
+def test_fx_no_midexpression_wrap():
+    """x*x followed by a real shift happens in int32 — the intermediate
+    product must NOT wrap before the shift (C promotion semantics)."""
+    src = """
+      let comp main = read[complex16] >>>
+        repeat {
+          x <- take;
+          var y : complex16 := complex16(0, 0);
+          do { y := (x * x) >> 8 };
+          emit y
+        } >>> write[complex16]
+    """
+    iq = np.array([[300, 200]], np.int16)
+    out = run_fxp(src, iq)
+    # int32 products: (50000, 120000) >> 8 = (195, 468) — in-range, so
+    # the store doesn't wrap; a premature int16 wrap would give garbage
+    np.testing.assert_array_equal(out, [[195, 468]])
+
+
+def test_fx_re_im_are_ints():
+    src = """
+      let comp main = read[complex16] >>>
+        repeat {
+          x <- take;
+          var r : int32 := 0;
+          do { r := x.re * x.re + x.im * x.im };
+          emit r
+        } >>> write[int32]
+    """
+    prog = compile_source(src, fxp_complex16=True)   # typechecker: ok
+    iq = np.array([[300, -200]], np.int16)
+    out = np.asarray(run(prog.comp, list(iq)).out_array())
+    np.testing.assert_array_equal(out, [300 * 300 + 200 * 200])
+
+
+def test_fx_complex_division_rejected():
+    src = """
+      let comp main = read[complex16] >>>
+        repeat { x <- take; emit x / x } >>> write[complex16]
+    """
+    with pytest.raises(ZiriaRuntimeError, match="fixed-point"):
+        run_fxp(src, np.array([[3, 4]], np.int16))
+
+
+def test_fx_interp_equals_jit_on_chain():
+    """The golden fxp TX chain: interp == jit bit for bit, and every
+    output level is exactly +-362."""
+    import os
+    here = os.path.dirname(__file__)
+    src = open(os.path.join(here, "..", "examples",
+                            "tx_qpsk_fxp.zir")).read()
+    rng = np.random.default_rng(5)
+    bits = rng.integers(0, 2, 192).astype(np.uint8)
+    a = run_fxp(src, bits, "interp")
+    b = run_fxp(src, bits, "jit")
+    np.testing.assert_array_equal(a, b)
+    assert set(np.unique(a)) <= {-362, 362}
+
+
+def test_fx_chain_matches_ops_oracle():
+    """tx_qpsk_fxp == the ops/ chain (scramble ^ seq -> conv_encode ->
+    interleave(96, 2) -> QPSK at round(512/sqrt(2))) — exact ints."""
+    import os
+
+    from ziria_tpu.ops.coding import np_conv_encode_ref
+    from ziria_tpu.ops.interleave import interleave
+    from ziria_tpu.ops.scramble import np_lfsr_sequence_127
+
+    here = os.path.dirname(__file__)
+    src = open(os.path.join(here, "..", "examples",
+                            "tx_qpsk_fxp.zir")).read()
+    rng = np.random.default_rng(6)
+    n_bits = 96 * 2      # -> 192*2 coded bits = 4 interleaver blocks
+    bits = rng.integers(0, 2, n_bits).astype(np.uint8)
+    got = run_fxp(src, bits, "jit")
+
+    seed = np.array([1, 0, 1, 1, 1, 0, 1], np.uint8)
+    scr = bits ^ np.resize(np_lfsr_sequence_127(seed), n_bits)
+    coded = np_conv_encode_ref(scr)
+    inter = np.concatenate([
+        np.asarray(interleave(coded[k:k + 96], 96, 2))
+        for k in range(0, coded.size, 96)])
+    lvl = 362
+    want = np.stack([np.where(inter[0::2] == 1, lvl, -lvl),
+                     np.where(inter[1::2] == 1, lvl, -lvl)],
+                    axis=-1).astype(np.int16)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_default_policy_unchanged():
+    """Without the flag, complex16 still evaluates as complex64."""
+    prog = compile_source(MUL_SRC)
+    iq = np.array([[3, 4]], np.int16)
+    out = np.asarray(run(prog.comp, list(iq)).out_array())
+    np.testing.assert_array_equal(out, [[-7, 24]])   # (3+4j)^2
+
+
+def test_fx_declared_int_pairs_stay_elementwise():
+    """Review r2: a declared arr[2] int under the policy must multiply
+    elementwise, not complex-wise (declared types beat the pair
+    heuristic)."""
+    src = """
+      let comp main = read[int32] >>>
+        repeat {
+          (p : arr[2] int32) <- takes 2;
+          var a : arr[2] int32 := {0, 0};
+          do { a := p * p };
+          emits a
+        } >>> write[int32]
+    """
+    xs = np.array([3, 4], np.int32)
+    out = run_fxp(src, xs)
+    np.testing.assert_array_equal(out, [9, 16])   # NOT (-7, 24)
+
+
+def test_fx_fractional_scale_rejected():
+    src = """
+      let comp main = read[complex16] >>>
+        repeat { x <- take; emit x * 0.5 } >>> write[complex16]
+    """
+    with pytest.raises(ZiriaRuntimeError, match="fractional"):
+        run_fxp(src, np.array([[100, 100]], np.int16))
+
+
+def test_fx_fft_ext_boundary():
+    """v_fft under the policy: pairs convert to complex64 at the ext
+    boundary (the documented f32 interior), and the complex16 return
+    requantizes — matching the f32 reference FFT to +-1 LSB."""
+    src = """
+      ext fun v_fft(x: arr[64] complex16) : arr[64] complex16
+      let comp main = read[complex16] >>>
+        repeat {
+          (x : arr[64] complex16) <- takes 64;
+          var y : arr[64] complex16;
+          do { y := v_fft(x) };
+          emits y
+        } >>> write[complex16]
+    """
+    rng = np.random.default_rng(8)
+    iq = rng.integers(-500, 500, (64, 2)).astype(np.int16)
+    out = run_fxp(src, iq)
+    z = iq[:, 0].astype(np.float64) + 1j * iq[:, 1]
+    want = np.fft.fft(z)
+    got = out[:, 0] + 1j * out[:, 1]
+    assert np.abs(got - want).max() <= 1.0

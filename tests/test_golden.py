@@ -30,10 +30,11 @@ def _generator_cases():
         "make_golden", os.path.join(EXAMPLES, "make_golden.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return {name: mode for name, _ty, _mk, mode in mod.CASES}
+    return ({name: mode for name, _ty, _mk, mode in mod.CASES},
+            mod.FXP_CASES)
 
 
-_MODES = _generator_cases()
+_MODES, _FXP_CASES = _generator_cases()
 
 # quantized complex streams compare with atol=1; float LLR outputs
 # tolerate interp-f64 vs jit-f32 rounding; everything else exact
@@ -54,12 +55,15 @@ def test_golden(name, mode, atol, tmp_path):
         f"golden files missing for {name}; run examples/make_golden.py"
 
     outf = tmp_path / f"{name}.out"
-    rc = cli_main([
+    argv = [
         f"--src={src}", "--input=file", f"--input-file-name={infile}",
         f"--input-file-mode={mode}", "--output=file",
         f"--output-file-name={outf}", f"--output-file-mode={mode}",
         "--backend=jit",
-    ])
+    ]
+    if name in _FXP_CASES:
+        argv.append("--fxp-complex16")
+    rc = cli_main(argv)
     assert rc == 0
 
     prog = compile_file(src)

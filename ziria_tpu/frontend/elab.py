@@ -100,7 +100,8 @@ class RuntimeScope(E.Scope):
         ty = self.var_types.get(name)
         if ty is not None:
             value = E.cast_value(ty, value, ctx.structs,
-                                 lambda x: ctx.static_eval(x, self))
+                                 lambda x: ctx.static_eval(x, self),
+                                 fxp=ctx.fxp_complex16)
         try:
             self.env.set(name, value)
             return
@@ -239,11 +240,13 @@ _FILE_TY = {
 
 
 class Elaborator:
-    def __init__(self, prog: A.Program, src_name: str = "<input>"):
+    def __init__(self, prog: A.Program, src_name: str = "<input>",
+                 fxp_complex16: bool = False):
         self.prog = prog
         self.src = src_name
         self.gscope = E.Scope()
-        self.ctx = E.Ctx(exts=dict(BUILTINS))
+        self.ctx = E.Ctx(exts=dict(BUILTINS),
+                         fxp_complex16=fxp_complex16)
         self.comp_funs: Dict[str, A.DFunComp] = {}
         self.ext_sigs: Dict[str, A.DExt] = {}
         self.top_comps: Dict[str, ir.Comp] = {}
@@ -298,7 +301,8 @@ class Elaborator:
                 v = E.eval_expr(e, ee.static, self.ctx)
                 if cast_ty is not None:
                     v = E.cast_value(cast_ty, v, self.ctx.structs,
-                                     lambda x: self.st_eval(x, ee))
+                                     lambda x: self.st_eval(x, ee),
+                                     fxp=self.ctx.fxp_complex16)
                 return v
             except Exception:
                 pass
@@ -309,7 +313,8 @@ class Elaborator:
             v = E.eval_expr(_e, scope, ctx)
             if _ty is not None:
                 v = E.cast_value(_ty, v, ctx.structs,
-                                 lambda x: ctx.static_eval(x, scope))
+                                 lambda x: ctx.static_eval(x, scope),
+                                 fxp=ctx.fxp_complex16)
             return v
 
         return run
@@ -410,7 +415,8 @@ class Elaborator:
             init = (self.closure(c.init, ee, cast_ty=c.ty)
                     if c.init is not None
                     else E.zero_value(c.ty, self.ctx.structs,
-                                      lambda x: self.st_eval(x, ee)))
+                                      lambda x: self.st_eval(x, ee),
+                                      fxp=self.ctx.fxp_complex16))
             init = _device_init(init, c.ty)
             ln = self._ty_len(c.ty, ee)
             ee2 = ee.with_runtime(c.name, c.ty, ln)
@@ -556,7 +562,8 @@ class Elaborator:
                 if ok and _is_pure(a):
                     if p.ty is not None:
                         v = E.cast_value(p.ty, v, self.ctx.structs,
-                                         lambda x: self.st_eval(x, ee))
+                                         lambda x: self.st_eval(x, ee),
+                                         fxp=self.ctx.fxp_complex16)
                     ee2 = ee2.with_static(p.name, v)
                 else:
                     ln = self.static_len(a, ee)
@@ -599,6 +606,7 @@ class Elaborator:
                     raise _err(self.src, d.loc, str(e)) from None
                 self.ctx.exts[d.name] = fn
                 self.ext_sigs[d.name] = d
+                self.ctx.ext_sigs[d.name] = d
             elif isinstance(d, A.DLet):
                 v = E.eval_expr(d.e, self.gscope, self.ctx)
                 self.gscope.declare(d.name, v, None, mutable=False)
@@ -641,8 +649,9 @@ class Elaborator:
                 f"`let comp main = ...`")
         body, in_ty, out_ty = self._split_io(cast)
         comp = localize(self.elab_comp(body, base))
-        comp, in_name = _input_adapter(comp, in_ty, self.src)
-        comp, out_name = _output_adapter(comp, out_ty, self.src)
+        fxp = self.ctx.fxp_complex16
+        comp, in_name = _input_adapter(comp, in_ty, self.src, fxp)
+        comp, out_name = _output_adapter(comp, out_ty, self.src, fxp)
         return CompiledProgram(comp, in_name, out_name, entry,
                                dict(self.top_comps))
 
@@ -749,10 +758,20 @@ def _to_arr(v: Any, ty: A.Ty):
     return np.asarray(v)
 
 
-def _input_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str):
+def _input_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str,
+                   fxp: bool = False):
     if ty is None:
         return comp, None
     name = _file_ty(ty, src)
+    if fxp and name == "complex16":
+        # fixed-point policy: items stay integer IQ pairs on the wire
+        # AND in the program — just widen storage to int32 so C-style
+        # promotion holds mid-expression
+        def to_fx(p):
+            xp = np if E._np_ok(p) else E._jnp()
+            return xp.asarray(p, np.int32)
+
+        return ir.Pipe(ir.Map(to_fx, name="iq_to_fx"), comp), name
     if name in ("complex16", "complex32"):
         def to_c64(p):
             # numpy for concrete items (the interpreter's per-sample
@@ -766,10 +785,23 @@ def _input_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str):
     return comp, name
 
 
-def _output_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str):
+def _output_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str,
+                    fxp: bool = False):
     if ty is None:
         return comp, None
     name = _file_ty(ty, src)
+    if fxp and name == "complex16":
+        def fx_to_iq(z):
+            # wrap to int16 exactly as a complex16 store does; accepts
+            # f32/c64 values too (rounded) for mixed f32 blocks (FFT)
+            xp = np if E._np_ok(z) else E._jnp()
+            a = xp.asarray(z)
+            if np.dtype(a.dtype).kind == "c":
+                a = xp.stack([xp.round(xp.real(a)),
+                              xp.round(xp.imag(a))], axis=-1)
+            return E.fx_wrap16(a).astype(np.int16)
+
+        return ir.Pipe(comp, ir.Map(fx_to_iq, name="fx_to_iq")), name
     if name in ("complex16", "complex32"):
         dt = np.int16 if name == "complex16" else np.int32
 
@@ -796,13 +828,16 @@ def _file_ty(ty: A.Ty, src: str) -> str:
 
 
 def compile_source(src: str, src_name: str = "<input>",
-                   entry: str = "main",
-                   typecheck: bool = True) -> CompiledProgram:
+                   entry: str = "main", typecheck: bool = True,
+                   fxp_complex16: bool = False) -> CompiledProgram:
     prog = parse_program(src, src_name)
-    return Elaborator(prog, src_name).build(entry, typecheck=typecheck)
+    return Elaborator(prog, src_name, fxp_complex16=fxp_complex16) \
+        .build(entry, typecheck=typecheck)
 
 
-def compile_file(path: str, entry: str = "main",
-                 typecheck: bool = True) -> CompiledProgram:
+def compile_file(path: str, entry: str = "main", typecheck: bool = True,
+                 fxp_complex16: bool = False) -> CompiledProgram:
     with open(path, "r") as fh:
-        return compile_source(fh.read(), path, entry, typecheck=typecheck)
+        return compile_source(fh.read(), path, entry,
+                              typecheck=typecheck,
+                              fxp_complex16=fxp_complex16)

@@ -112,13 +112,63 @@ class StructDef:
     fields: Tuple[Tuple[str, A.Ty], ...]
 
 
+def fx_is_pair(v: Any) -> bool:
+    """Is `v` plausibly a fixed-point complex16 value (signed-integer
+    IQ-pair array)? A shape heuristic: under the opt-in policy a
+    (..., 2) signed-int array is treated as complex16 by * and == when
+    no declared type says otherwise (EBin consults declared var types
+    first — see _fx_ty_hint). Unsigned arrays (bit streams) never
+    match."""
+    return (hasattr(v, "dtype") and v.ndim >= 1 and v.shape[-1] == 2
+            and np.issubdtype(np.dtype(v.dtype), np.signedinteger))
+
+
+def fx_wrap16(v):
+    """Wrap integer components to int16 range, keep int32 storage
+    (the C shorts store-narrowing, without losing the promoted width
+    for the next operation)."""
+    xp = np if _np_ok(v) else _jnp()
+    x = xp.asarray(v)
+    if not np.issubdtype(np.dtype(x.dtype), np.integer):
+        x = xp.round(x)
+    return x.astype(np.int16).astype(np.int32)
+
+
+def fx_pair(re, im) -> Any:
+    """Build a fixed-point complex16 from components (wrapped)."""
+    xp = np if _np_ok(re, im) else _jnp()
+    return xp.stack([fx_wrap16(re), fx_wrap16(im)], axis=-1)
+
+
+def _fx_cast(v: Any) -> Any:
+    """Coerce any complex-ish value to a fixed-point IQ pair."""
+    if is_static(v):
+        c = complex(v)
+        return fx_pair(np.int64(round(c.real)), np.int64(round(c.imag)))
+    if fx_is_pair(v):
+        return fx_wrap16(v)
+    xp = np if _np_ok(v) else _jnp()
+    a = xp.asarray(v)
+    if np.dtype(a.dtype).kind == "c":
+        return fx_pair(xp.real(a), xp.imag(a))
+    if a.ndim >= 1 and a.shape[-1] == 2:
+        return fx_pair(a[..., 0], a[..., 1])   # float pairs round+wrap
+    raise ZiriaRuntimeError(
+        f"cannot cast value of shape {np.shape(v)} to fixed-point "
+        f"complex16 (expected complex or (..., 2) pair)")
+
+
 def cast_value(ty: Optional[A.Ty], v: Any, structs: Dict[str, StructDef],
-               static_eval: Optional[Callable] = None) -> Any:
-    """Cast `v` to surface type `ty` (None = leave as-is)."""
+               static_eval: Optional[Callable] = None,
+               fxp: bool = False) -> Any:
+    """Cast `v` to surface type `ty` (None = leave as-is). `fxp` is the
+    Ctx.fxp_complex16 policy: complex16 becomes an int32 IQ pair."""
     if ty is None:
         return v
     jnp = _jnp()
     if isinstance(ty, A.TBase):
+        if fxp and ty.name == "complex16":
+            return _fx_cast(v)
         if ty.name == "bit" and is_static(v):
             return int(v) & 1
         if ty.name in ("int", "int8", "int16", "int32", "int64") \
@@ -137,13 +187,21 @@ def cast_value(ty: Optional[A.Ty], v: Any, structs: Dict[str, StructDef],
         xp = np if _np_ok(v) else jnp
         if ty.name == "bit":
             return xp.asarray(v).astype(np.uint8) & np.uint8(1)
+        if ty.name in _CPLX and fx_is_pair(v):
+            # fx pair -> float complex (the f32 interop cast, e.g. FFT)
+            a = xp.asarray(v, np.float32)
+            return (a[..., 0] + 1j * a[..., 1]).astype(dt)
         return xp.asarray(v).astype(dt)
     if isinstance(ty, A.TArr):
-        arr = np.asarray(v) if _np_ok(v) else jnp.asarray(v)
-        edt = base_dtype(ty.elem.name) if isinstance(ty.elem, A.TBase) \
-            else None
-        if edt is not None and arr.dtype != edt:
-            arr = arr.astype(edt)
+        if fxp and isinstance(ty.elem, A.TBase) \
+                and ty.elem.name == "complex16":
+            arr = _fx_cast(v)
+        else:
+            arr = np.asarray(v) if _np_ok(v) else jnp.asarray(v)
+            edt = base_dtype(ty.elem.name) \
+                if isinstance(ty.elem, A.TBase) else None
+            if edt is not None and arr.dtype != edt:
+                arr = arr.astype(edt)
         if ty.n is not None and static_eval is not None:
             n = static_eval(ty.n)
             if int(arr.shape[0]) != int(n):
@@ -169,8 +227,10 @@ def cast_value(ty: Optional[A.Ty], v: Any, structs: Dict[str, StructDef],
 
 
 def zero_value(ty: A.Ty, structs: Dict[str, StructDef],
-               static_eval: Callable) -> Any:
+               static_eval: Callable, fxp: bool = False) -> Any:
     if isinstance(ty, A.TBase):
+        if fxp and ty.name == "complex16":
+            return np.zeros(2, np.int32)
         if ty.name == "bit":
             return 0
         if ty.name in _INT_DTYPES:
@@ -190,15 +250,18 @@ def zero_value(ty: A.Ty, structs: Dict[str, StructDef],
         # backend's trace these are initial constants that promote to
         # jnp on first traced assignment
         n = int(static_eval(ty.n))
+        if fxp and isinstance(ty.elem, A.TBase) \
+                and ty.elem.name == "complex16":
+            return np.zeros((n, 2), np.int32)
         if isinstance(ty.elem, A.TBase):
             return np.zeros((n,), base_dtype(ty.elem.name))
-        inner = zero_value(ty.elem, structs, static_eval)
+        inner = zero_value(ty.elem, structs, static_eval, fxp)
         return np.zeros((n,) + tuple(np.shape(inner)),
                         getattr(inner, "dtype", np.float32))
     if isinstance(ty, A.TStruct):
         sd = structs[ty.name]
         return {"__struct__": sd.name,
-                **{fn: zero_value(fty, structs, static_eval)
+                **{fn: zero_value(fty, structs, static_eval, fxp)
                    for fn, fty in sd.fields}}
     raise ZiriaRuntimeError(f"no zero value for {ty}")
 
@@ -254,7 +317,8 @@ class Scope:
                 raise _rt_err(loc, f"assignment to immutable binding "
                                    f"{name!r} (declare it with `var`)")
             c.value = cast_value(c.ty, value, ctx.structs,
-                                 lambda x: ctx.static_eval(x, self)) \
+                                 lambda x: ctx.static_eval(x, self),
+                                 fxp=ctx.fxp_complex16) \
                 if c.ty is not None else value
             return
         if self.parent is not None:
@@ -292,6 +356,17 @@ class Ctx:
     exts: Dict[str, Callable] = field(default_factory=dict)
     structs: Dict[str, StructDef] = field(default_factory=dict)
     on_print: Callable[[str], None] = print
+    # opt-in int16 fixed-point complex16 policy (SURVEY.md §7 hard-part
+    # (b)): complex16 values are (..., 2) int32 IQ pairs — the same
+    # pair-last layout ops/cplx.py uses for f32 — with C shorts
+    # semantics (components promote to int32 in arithmetic, wrap to
+    # int16 at assignment/cast). See fx_* helpers below.
+    fxp_complex16: bool = False
+    # declared ext signatures (filled by the elaborator) — under the
+    # fxp policy, complex-typed ext params convert pair -> complex64 at
+    # the call boundary and complex16 returns requantize, so f32 bricks
+    # like v_fft keep their documented f32 interior
+    ext_sigs: Dict[str, Any] = field(default_factory=dict)
 
     def static_eval(self, e: A.Expr, scope: Optional[Scope] = None) -> Any:
         """Evaluate `e` and require a static Python value (array lengths,
@@ -350,8 +425,76 @@ def _promote_narrow_np(x: np.ndarray) -> np.ndarray:
     return x
 
 
-def _binop(op: str, a: Any, b: Any, loc) -> Any:
+def _fx_split(v, loc=(0, 0)):
+    """(re, im) integer components of a fixed-point operand; integer
+    real scalars/arrays get im = 0. Fractional real operands are an
+    ERROR, not a silent round — scaling a fixed-point value by 0.5
+    must be written as an explicit shift/Q15 op (the same rule C
+    programmers live by)."""
+    if fx_is_pair(v):
+        return v[..., 0], v[..., 1]
+    if is_static(v):
+        c = complex(v)
+        if c.real != int(c.real) or c.imag != int(c.imag):
+            raise _rt_err(loc, f"cannot mix fixed-point complex16 with "
+                               f"the fractional value {v!r}; scale with "
+                               f"integer arithmetic, shifts, or the Q15 "
+                               f"ext helpers")
+        return int(c.real), int(c.imag)
+    xp = np if _np_ok(v) else _jnp()
+    a = xp.asarray(v)
+    if np.dtype(a.dtype).kind == "c":
+        return (xp.round(xp.real(a)).astype(np.int32),
+                xp.round(xp.imag(a)).astype(np.int32))
+    if not np.issubdtype(np.dtype(a.dtype), np.integer):
+        raise _rt_err(loc, "cannot mix fixed-point complex16 with a "
+                           "float array; quantize it explicitly (the "
+                           "policy keeps everything in the integer "
+                           "domain)")
+    return a.astype(np.int32), xp.zeros(a.shape, np.int32)
+
+
+def _fx_binop(op: str, a: Any, b: Any, loc):
+    """Fixed-point complex16 operator semantics (C shorts model:
+    components are int32 mid-expression, wrap to int16 at
+    assignment/cast). Returns NotImplemented for ops whose elementwise
+    fallthrough is already correct (shifts, real-scalar / and %)."""
+    if op in ("==", "!="):
+        ar, ai = _fx_split(a, loc)
+        br, bi = _fx_split(b, loc)
+        xp = np if _np_ok(ar, ai, br, bi) else _jnp()
+        eq = xp.logical_and(xp.asarray(ar == br), xp.asarray(ai == bi))
+        return eq if op == "==" else xp.logical_not(eq)
+    if op == "*":
+        ar, ai = _fx_split(a, loc)
+        br, bi = _fx_split(b, loc)
+        xp = np if _np_ok(ar, ai, br, bi) else _jnp()
+        return xp.stack([xp.asarray(ar * br - ai * bi),
+                         xp.asarray(ar * bi + ai * br)], axis=-1)
+    if op in ("+", "-"):
+        if fx_is_pair(a) and fx_is_pair(b):
+            return NotImplemented          # elementwise is exact
+        ar, ai = _fx_split(a, loc)
+        br, bi = _fx_split(b, loc)
+        xp = np if _np_ok(ar, ai, br, bi) else _jnp()
+        if op == "+":
+            return xp.stack([xp.asarray(ar + br),
+                             xp.asarray(ai + bi)], axis=-1)
+        return xp.stack([xp.asarray(ar - br),
+                         xp.asarray(ai - bi)], axis=-1)
+    if op in ("/", "%") and fx_is_pair(a) and fx_is_pair(b):
+        raise _rt_err(loc, f"fixed-point complex16 has no {op!r} "
+                           f"between complex values; scale by real "
+                           f"scalars or use the Q15 ext helpers")
+    return NotImplemented      # shifts / real-divisor ops: elementwise
+
+
+def _binop(op: str, a: Any, b: Any, loc, fxp: bool = False) -> Any:
     jnp = _jnp()
+    if fxp and (fx_is_pair(a) or fx_is_pair(b)):
+        r = _fx_binop(op, a, b, loc)
+        if r is not NotImplemented:
+            return r
     both_static = is_static(a) and is_static(b)
     if op == "&&":
         return (bool(a) and bool(b)) if both_static \
@@ -457,6 +600,36 @@ _BASE_TYPE_NAMES = frozenset(
      "complex", "complex16", "complex32"))
 
 
+def _fx_ty_hint(e: A.Expr, scope: Scope):
+    """Does `e`'s DECLARED type say complex16 (True), say something
+    non-complex (False), or say nothing (None)? Used so the fx pair
+    heuristic never hijacks arithmetic on variables the program
+    declared as plain int arrays."""
+    if isinstance(e, A.EBin):
+        ha = _fx_ty_hint(e.a, scope)
+        hb = _fx_ty_hint(e.b, scope)
+        if ha is True or hb is True:
+            return True
+        if ha is False and hb is False:
+            return False
+        return None
+    if isinstance(e, A.ECall) and e.name in _BASE_TYPE_NAMES:
+        return e.name == "complex16"
+    ty = None
+    if isinstance(e, A.EVar):
+        c = scope.find(e.name)
+        ty = c.ty if c is not None else None
+    elif isinstance(e, (A.EIdx, A.ESlice)) and isinstance(e.arr, A.EVar):
+        c = scope.find(e.arr.name)
+        if c is not None and isinstance(c.ty, A.TArr):
+            ty = c.ty.elem
+    if isinstance(ty, A.TArr):
+        ty = ty.elem
+    if isinstance(ty, A.TBase):
+        return ty.name == "complex16"
+    return None
+
+
 def eval_expr(e: A.Expr, scope: Scope, ctx: Ctx) -> Any:
     jnp = _jnp()
     if isinstance(e, A.EInt):
@@ -482,8 +655,11 @@ def eval_expr(e: A.Expr, scope: Scope, ctx: Ctx) -> Any:
             return (not v) if is_static(v) else xp.logical_not(v)
         raise _rt_err(e.loc, f"unknown unary {e.op!r}")
     if isinstance(e, A.EBin):
+        fxp = ctx.fxp_complex16
+        if fxp and _fx_ty_hint(e, scope) is False:
+            fxp = False       # declared non-complex: stay elementwise
         return _binop(e.op, eval_expr(e.a, scope, ctx),
-                      eval_expr(e.b, scope, ctx), e.loc)
+                      eval_expr(e.b, scope, ctx), e.loc, fxp=fxp)
     if isinstance(e, A.ECond):
         c = eval_expr(e.c, scope, ctx)
         if is_static(c):
@@ -535,6 +711,8 @@ def eval_expr(e: A.Expr, scope: Scope, ctx: Ctx) -> Any:
         return lax.dynamic_slice_in_dim(arr, i, int(n))
     if isinstance(e, A.EField):
         v = eval_expr(e.e, scope, ctx)
+        if ctx.fxp_complex16 and e.f in ("re", "im") and fx_is_pair(v):
+            return v[..., 0] if e.f == "re" else v[..., 1]
         if isinstance(v, dict):
             if e.f not in v:
                 raise _rt_err(e.loc, f"struct {v.get('__struct__')} has "
@@ -558,9 +736,36 @@ def eval_expr(e: A.Expr, scope: Scope, ctx: Ctx) -> Any:
             raise _rt_err(e.loc, f"unknown struct {e.name!r}")
         v = {fn: eval_expr(fe, scope, ctx) for fn, fe in e.fields}
         return cast_value(A.TStruct(e.name), v, ctx.structs,
-                          lambda x: ctx.static_eval(x, scope))
+                          lambda x: ctx.static_eval(x, scope),
+                          fxp=ctx.fxp_complex16)
     raise _rt_err(getattr(e, "loc", (0, 0)),
                   f"unknown expression node {type(e).__name__}")
+
+
+def _ty_is_cplx(ty) -> Optional[str]:
+    t = ty.elem if isinstance(ty, A.TArr) else ty
+    if isinstance(t, A.TBase) and t.name in _CPLX:
+        return t.name
+    return None
+
+
+def _fx_ext_arg(v: Any, ty) -> Any:
+    """Pair -> complex64 at a complex-typed ext boundary (fxp policy:
+    f32 is retained only inside explicitly complex-typed ext bricks
+    such as v_fft)."""
+    if _ty_is_cplx(ty) and fx_is_pair(v):
+        xp = np if _np_ok(v) else _jnp()
+        a = xp.asarray(v, np.float32)
+        return (a[..., 0] + 1j * a[..., 1]).astype(np.complex64)
+    return v
+
+
+def _fx_ext_ret(v: Any, ty) -> Any:
+    """complex16-typed ext results requantize back to pairs; wider
+    complex return types stay in the f32 domain."""
+    if _ty_is_cplx(ty) == "complex16" and not fx_is_pair(v):
+        return _fx_cast(v)
+    return v
 
 
 def _eval_call(e: A.ECall, scope: Scope, ctx: Ctx) -> Any:
@@ -571,6 +776,8 @@ def _eval_call(e: A.ECall, scope: Scope, ctx: Ctx) -> Any:
     if name in _BASE_TYPE_NAMES:
         if name in _CPLX and len(args) == 2:
             re, im = args
+            if ctx.fxp_complex16 and name == "complex16":
+                return fx_pair(re, im)
             if is_static(re) and is_static(im):
                 return complex(re, im)
             xp = np if _np_ok(re, im) else jnp
@@ -580,7 +787,8 @@ def _eval_call(e: A.ECall, scope: Scope, ctx: Ctx) -> Any:
         if len(args) != 1:
             raise _rt_err(e.loc, f"cast {name} takes one argument")
         return cast_value(A.TBase(name), args[0], ctx.structs,
-                          lambda x: ctx.static_eval(x, scope))
+                          lambda x: ctx.static_eval(x, scope),
+                          fxp=ctx.fxp_complex16)
     # user expression functions
     fd = ctx.funs.get(name)
     if fd is not None:
@@ -588,6 +796,11 @@ def _eval_call(e: A.ECall, scope: Scope, ctx: Ctx) -> Any:
     # ext / builtin functions
     fn = ctx.exts.get(name)
     if fn is not None:
+        sig = ctx.ext_sigs.get(name) if ctx.fxp_complex16 else None
+        if sig is not None:
+            args = [_fx_ext_arg(v, p.ty)
+                    for v, p in zip(args, sig.params)]
+            return _fx_ext_ret(fn(*args), sig.ret_ty)
         return fn(*args)
     # print family
     if name in ("print", "println", "error"):
@@ -629,13 +842,15 @@ def call_fun(fd: FunDef, args: List[Any], ctx: Ctx, loc=(0, 0)) -> Any:
         # length-polymorphic array params adopt the argument's length
         if ty is not None:
             v = cast_value(ty, v, ctx.structs,
-                           lambda x: ctx.static_eval(x, fd.closure))
+                           lambda x: ctx.static_eval(x, fd.closure),
+                           fxp=ctx.fxp_complex16)
         s.declare(p.name, v, ty, mutable=False)
     r = exec_stmts(d.body, s, ctx)
     v = r[1] if r is not None else None
     if d.ret_ty is not None and v is not None:
         v = cast_value(d.ret_ty, v, ctx.structs,
-                       lambda x: ctx.static_eval(x, fd.closure))
+                       lambda x: ctx.static_eval(x, fd.closure),
+                       fxp=ctx.fxp_complex16)
     return v
 
 
@@ -659,16 +874,18 @@ def exec_stmt(st: A.Stmt, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
         se = lambda x: ctx.static_eval(x, scope)   # noqa: E731
         if st.init is not None:
             v = cast_value(st.ty, eval_expr(st.init, scope, ctx),
-                           ctx.structs, se)
+                           ctx.structs, se, fxp=ctx.fxp_complex16)
         else:
-            v = zero_value(st.ty, ctx.structs, se)
+            v = zero_value(st.ty, ctx.structs, se,
+                           fxp=ctx.fxp_complex16)
         scope.declare(st.name, v, st.ty, mutable=True)
         return None
     if isinstance(st, A.SLet):
         v = eval_expr(st.e, scope, ctx)
         if st.ty is not None:
             v = cast_value(st.ty, v, ctx.structs,
-                           lambda x: ctx.static_eval(x, scope))
+                           lambda x: ctx.static_eval(x, scope),
+                           fxp=ctx.fxp_complex16)
         scope.declare(st.name, v, st.ty, mutable=False)
         return None
     if isinstance(st, A.SAssign):

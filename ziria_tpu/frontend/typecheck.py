@@ -307,6 +307,8 @@ class TypeChecker:
         self._fun_sigs: Dict[str, Tuple[List[TcTy], TcTy]] = {}
         self._comp_stack: List[str] = []
         self._checked_funs: set = set()
+        # under the fixed-point policy, complex16 components are ints
+        self.fxp = getattr(elab.ctx, "fxp_complex16", False)
 
     # ------------------------------------------------------------- errors
 
@@ -809,6 +811,8 @@ class TypeChecker:
             if isinstance(b, Base) and _kind(b) != 3 and not b.weak:
                 raise self.err(loc, f".{f} on non-complex {t.show()}")
             d = Base("double")
+            if self.fxp and isinstance(b, Base) and b.name == "complex16":
+                d = Base("int32")      # fixed-point components are ints
             return Arr(d, t.n) if isinstance(t, Arr) else d
         raise self.err(loc, f"no field {f!r} on a non-struct value")
 

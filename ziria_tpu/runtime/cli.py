@@ -152,6 +152,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fold", action="store_true", default=True)
     p.add_argument("--no-fold", dest="fold", action="store_false")
     p.add_argument("--autolut", action="store_true")
+    p.add_argument("--fxp-complex16", action="store_true",
+                   help="int16 fixed-point complex16 policy: stream "
+                        "items and arithmetic are integer IQ pairs "
+                        "with C shorts semantics (wrap at store); "
+                        "f32 is retained only inside explicitly "
+                        "complex-typed ext calls such as v_fft")
     p.add_argument("--ddump-fold", action="store_true",
                    help="dump the IR after folding")
     p.add_argument("--ddump-vect", action="store_true",
@@ -182,7 +188,8 @@ def _resolve_prog(args):
     """Returns (comp, default_in_ty, default_out_ty)."""
     if args.src:
         from ziria_tpu.frontend import compile_file
-        prog = compile_file(args.src)
+        prog = compile_file(args.src,
+                            fxp_complex16=args.fxp_complex16)
         return prog.comp, prog.in_ty, prog.out_ty
     if not args.prog:
         raise SystemExit("need --prog=NAME or --src=FILE "
