@@ -189,3 +189,39 @@ def test_fx_fft_ext_boundary():
     want = np.fft.fft(z)
     got = out[:, 0] + 1j * out[:, 1]
     assert np.abs(got - want).max() <= 1.0
+
+
+def test_fx_map_ext_boundary():
+    """Review r2: the `map <ext>` form must apply the same ext-boundary
+    conversion as expression calls — v_fft over a complex16 stream
+    under the policy matches the reference FFT."""
+    src = """
+      ext fun v_fft(x: arr[64] complex16) : arr[64] complex16
+      let comp main = read[complex16] >>> map v_fft >>> write[complex16]
+    """
+    rng = np.random.default_rng(9)
+    iq = rng.integers(-400, 400, (64, 2)).astype(np.int16)
+    out = run_fxp(src, iq)
+    assert out.shape == (64, 2)
+    z = iq[:, 0].astype(np.float64) + 1j * iq[:, 1]
+    want = np.fft.fft(z)
+    got = out[:, 0] + 1j * out[:, 1]
+    assert np.abs(got - want).max() <= 1.0
+
+
+@pytest.mark.parametrize("backend", ["interp", "jit"])
+def test_fx_overflowing_float_wrap_deterministic(backend):
+    """Review r2: float values beyond int16 range (e.g. full-scale FFT
+    components) wrap MODULARLY and identically on both backends —
+    astype(int16) alone saturates under XLA but wraps under numpy."""
+    src = """
+      ext fun v_fft(x: arr[64] complex16) : arr[64] complex16
+      let comp main = read[complex16] >>> map v_fft >>> write[complex16]
+    """
+    iq = np.full((64, 2), 20000, np.int16)   # DC -> bin0 ~ 1.28e6
+    out = run_fxp(src, iq, backend)
+    z = iq[:, 0].astype(np.float64) + 1j * iq[:, 1]
+    f = np.fft.fft(z)
+    wrap = lambda v: ((int(round(v)) + 2**15) % 2**16) - 2**15  # noqa
+    want = np.stack([[wrap(c.real), wrap(c.imag)] for c in f])
+    np.testing.assert_array_equal(out.astype(np.int64), want)

@@ -74,3 +74,70 @@ def test_wifi_chains_typecheck():
     prog = tx.tx_symbol_pipeline(36)
     ty = typecheck(prog)
     assert isinstance(ty, (CTy, TTy))
+
+
+# ------------------------------------------------ item-dtype unification
+
+
+def test_pipe_dtype_conflict_rejected():
+    """A complex-producing stage feeding a real-consuming stage is a
+    stream type error (VERDICT r1 weak #6 — previously two opaque
+    TVars unified silently)."""
+    import pytest
+
+    import ziria_tpu as z
+    from ziria_tpu.core.types import ZiriaTypeError, typecheck
+
+    good = z.pipe(z.zmap(lambda x: x, out_dtype="complex64"),
+                  z.zmap(lambda x: x, in_dtype="complex64"))
+    typecheck(good)
+
+    bad = z.pipe(z.zmap(lambda x: x, out_dtype="uint8"),
+                 z.zmap(lambda x: x, in_dtype="complex64"))
+    with pytest.raises(ZiriaTypeError, match="dtype mismatch"):
+        typecheck(bad)
+
+
+def test_pipe_dtype_widths_compatible():
+    """Width changes are legal implicit casts — int16 feeding int32
+    must NOT error (only the complex/real boundary is hard)."""
+    import ziria_tpu as z
+    from ziria_tpu.core.types import typecheck
+
+    typecheck(z.pipe(z.zmap(lambda x: x, out_dtype="int16"),
+                     z.zmap(lambda x: x, in_dtype="int32")))
+    typecheck(z.pipe(z.zmap(lambda x: x, out_dtype="float32"),
+                     z.zmap(lambda x: x, in_dtype="int32")))
+
+
+def test_dtype_flows_through_branch_unification():
+    """Dtypes propagate along unification chains: branch arms unify, so
+    a complex-consuming arm and a bit-consuming arm conflict."""
+    import pytest
+
+    import ziria_tpu as z
+    from ziria_tpu.core.types import ZiriaTypeError, typecheck
+
+    bad = z.branch(lambda env: True,
+                   z.zmap(lambda x: x, in_dtype="complex64"),
+                   z.zmap(lambda x: x, in_dtype="uint8"))
+    with pytest.raises(ZiriaTypeError, match="dtype mismatch"):
+        typecheck(bad)
+
+
+def test_surface_dtype_conflict_from_signatures():
+    """Ext signatures carry dtypes into the IR, and build() runs the
+    stream typechecker: a complex-typed map feeding a bit-typed map is
+    rejected at compile time through compile_source itself."""
+    import pytest
+
+    from ziria_tpu.frontend import ElabError, compile_source
+
+    src = """
+      ext fun conj(x: complex16) : complex16
+      fun tobit(x: bit) : bit { return x }
+      let comp main = read[complex16] >>> map conj >>> map tobit
+                      >>> write[bit]
+    """
+    with pytest.raises(ElabError, match="dtype mismatch"):
+        compile_source(src)

@@ -112,20 +112,25 @@ def _map_fusions(c: ir.Comp) -> Optional[ir.Comp]:
         return ir.Map(_compose_maps(up.f, down.f), up.in_arity,
                       down.out_arity,
                       name=f"{down.label()}.{up.label()}",
-                      in_domain=up.in_domain)
+                      in_domain=up.in_domain,
+                      in_dtype=up.in_dtype, out_dtype=down.out_dtype)
     if (isinstance(up, ir.Map) and isinstance(down, ir.MapAccum)
             and up.out_arity == down.in_arity):
         def fa(s, x, _f=up.f, _g=down.f):
             return _g(s, _f(x))
         return ir.MapAccum(fa, down.init, up.in_arity, down.out_arity,
-                           name=f"{down.label()}.{up.label()}")
+                           name=f"{down.label()}.{up.label()}",
+                           in_dtype=up.in_dtype,
+                           out_dtype=down.out_dtype)
     if (isinstance(up, ir.MapAccum) and isinstance(down, ir.Map)
             and up.out_arity == down.in_arity):
         def fb(s, x, _f=up.f, _g=down.f):
             s2, y = _f(s, x)
             return s2, _g(y)
         return ir.MapAccum(fb, up.init, up.in_arity, down.out_arity,
-                           name=f"{down.label()}.{up.label()}")
+                           name=f"{down.label()}.{up.label()}",
+                           in_dtype=up.in_dtype,
+                           out_dtype=down.out_dtype)
     return None
 
 

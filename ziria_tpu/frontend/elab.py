@@ -248,7 +248,9 @@ class Elaborator:
         self.ctx = E.Ctx(exts=dict(BUILTINS),
                          fxp_complex16=fxp_complex16)
         self.comp_funs: Dict[str, A.DFunComp] = {}
-        self.ext_sigs: Dict[str, A.DExt] = {}
+        # single source of truth for ext signatures: the evaluator's
+        # registry (ctx.ext_sigs); self.ext_sigs aliases the SAME dict
+        self.ext_sigs = self.ctx.ext_sigs
         self.top_comps: Dict[str, ir.Comp] = {}
         self.top_comp_asts: Dict[str, A.Comp] = {}
         self._inlining: List[str] = []
@@ -510,16 +512,31 @@ class Elaborator:
             def f(x, _fd=fd, _ctx=ctx):
                 return E.call_fun(_fd, [x], _ctx)
 
+            fxp = self.ctx.fxp_complex16
             return ir.Map(f, in_arity=a, out_arity=b, name=name,
-                          in_domain=dom)
+                          in_domain=dom,
+                          in_dtype=_dtype_of(d.params[0].ty, fxp),
+                          out_dtype=_dtype_of(d.ret_ty, fxp))
         if name in self.ext_sigs:
             d = self.ext_sigs[name]
             fn = self.ctx.exts[name]
             a = (self._ty_len(d.params[0].ty, ee) or 1) if d.params else 1
             b = self._ty_len(d.ret_ty, ee) or 1
             dom = _domain_of(d.params[0].ty) if d.params else None
+            fxp = self.ctx.fxp_complex16
+            if fxp and d.params:
+                # the map form must honor the same ext-boundary policy
+                # as expression calls: complex-typed params see
+                # complex64, complex16 returns requantize (review r2)
+                pty, rty = d.params[0].ty, d.ret_ty
+
+                def fn(x, _fn=fn, _p=pty, _r=rty):
+                    return E._fx_ext_ret(_fn(E._fx_ext_arg(x, _p)), _r)
             return ir.Map(fn, in_arity=a, out_arity=b, name=name,
-                          in_domain=dom)
+                          in_domain=dom,
+                          in_dtype=(_dtype_of(d.params[0].ty, fxp)
+                                    if d.params else None),
+                          out_dtype=_dtype_of(d.ret_ty, fxp))
         if name in self.ctx.exts:
             return ir.Map(self.ctx.exts[name], name=name)
         raise _err(self.src, c.loc, f"map: unknown function {name!r}")
@@ -605,8 +622,7 @@ class Elaborator:
                 except KeyError as e:
                     raise _err(self.src, d.loc, str(e)) from None
                 self.ctx.exts[d.name] = fn
-                self.ext_sigs[d.name] = d
-                self.ctx.ext_sigs[d.name] = d
+                self.ext_sigs[d.name] = d   # aliases ctx.ext_sigs
             elif isinstance(d, A.DLet):
                 v = E.eval_expr(d.e, self.gscope, self.ctx)
                 self.gscope.declare(d.name, v, None, mutable=False)
@@ -652,6 +668,16 @@ class Elaborator:
         fxp = self.ctx.fxp_complex16
         comp, in_name = _input_adapter(comp, in_ty, self.src, fxp)
         comp, out_name = _output_adapter(comp, out_ty, self.src, fxp)
+        if typecheck:
+            # stream-level discipline + item-dtype unification on the
+            # final IR (core/types.py — the reference's TcComp/TcUnify
+            # composition rules)
+            from ziria_tpu.core.types import ZiriaTypeError as StreamTE
+            from ziria_tpu.core.types import typecheck as stream_tc
+            try:
+                stream_tc(comp)
+            except StreamTE as e:
+                raise ElabError(f"{self.src}: {e}") from None
         return CompiledProgram(comp, in_name, out_name, entry,
                                dict(self.top_comps))
 
@@ -720,6 +746,21 @@ def _is_pure(e: A.Expr) -> bool:
     return all(_is_pure(k) for k in kids if k is not None)
 
 
+def _dtype_of(ty: Optional[A.Ty], fxp: bool = False) -> Optional[str]:
+    """Numpy dtype name of a surface type's items (arrays use the
+    element type), feeding Map dtype hints for the stream typechecker.
+    Under the fixed-point policy complex16 items are int32 pairs."""
+    t = ty.elem if isinstance(ty, A.TArr) else ty
+    if not isinstance(t, A.TBase):
+        return None
+    if fxp and t.name == "complex16":
+        return "int32"
+    try:
+        return str(np.dtype(E.base_dtype(t.name)))
+    except Exception:
+        return None
+
+
 def _domain_of(ty: Optional[A.Ty]) -> Optional[int]:
     """AutoLUT input domain for small scalar types (SURVEY.md §2.1)."""
     if isinstance(ty, A.TBase):
@@ -771,7 +812,8 @@ def _input_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str,
             xp = np if E._np_ok(p) else E._jnp()
             return xp.asarray(p, np.int32)
 
-        return ir.Pipe(ir.Map(to_fx, name="iq_to_fx"), comp), name
+        return ir.Pipe(ir.Map(to_fx, name="iq_to_fx", in_dtype="int16",
+                              out_dtype="int32"), comp), name
     if name in ("complex16", "complex32"):
         def to_c64(p):
             # numpy for concrete items (the interpreter's per-sample
@@ -781,7 +823,9 @@ def _input_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str,
             p = xp.asarray(p, np.float32)
             return (p[0] + 1j * p[1]).astype(np.complex64)
 
-        return ir.Pipe(ir.Map(to_c64, name="iq_to_c64"), comp), name
+        return ir.Pipe(ir.Map(to_c64, name="iq_to_c64",
+                              in_dtype="int16", out_dtype="complex64"),
+                       comp), name
     return comp, name
 
 
@@ -801,7 +845,11 @@ def _output_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str,
                               xp.round(xp.imag(a))], axis=-1)
             return E.fx_wrap16(a).astype(np.int16)
 
-        return ir.Pipe(comp, ir.Map(fx_to_iq, name="fx_to_iq")), name
+        # no in_dtype hint: this adapter deliberately accepts BOTH
+        # int32 pairs and complex64 values (mixed f32 blocks), so a
+        # concrete hint would reject the complex case it supports
+        return ir.Pipe(comp, ir.Map(fx_to_iq, name="fx_to_iq",
+                                     out_dtype="int16")), name
     if name in ("complex16", "complex32"):
         dt = np.int16 if name == "complex16" else np.int32
 
@@ -811,7 +859,10 @@ def _output_adapter(comp: ir.Comp, ty: Optional[A.Ty], src: str,
             return xp.stack([xp.round(z.real),
                              xp.round(z.imag)]).astype(_dt)
 
-        return ir.Pipe(comp, ir.Map(to_iq, name="c64_to_iq")), name
+        return ir.Pipe(comp, ir.Map(to_iq, name="c64_to_iq",
+                                     in_dtype="complex64",
+                                     out_dtype=("int16" if dt is np.int16
+                                                else "int32"))), name
     return comp, name
 
 

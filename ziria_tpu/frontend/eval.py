@@ -124,13 +124,17 @@ def fx_is_pair(v: Any) -> bool:
 
 
 def fx_wrap16(v):
-    """Wrap integer components to int16 range, keep int32 storage
-    (the C shorts store-narrowing, without losing the promoted width
-    for the next operation)."""
+    """Wrap components to int16 range, keep int32 storage (the C shorts
+    store-narrowing, without losing the promoted width for the next
+    operation). Floats round to int64 first and wrap MODULARLY —
+    astype(int16) on an out-of-range float is implementation-defined
+    under XLA (saturates) but wraps under numpy, which would break the
+    interp == jit invariant (review r2)."""
     xp = np if _np_ok(v) else _jnp()
     x = xp.asarray(v)
     if not np.issubdtype(np.dtype(x.dtype), np.integer):
-        x = xp.round(x)
+        x = xp.round(x).astype(np.int64)
+        return (((x + 32768) % 65536) - 32768).astype(np.int32)
     return x.astype(np.int16).astype(np.int32)
 
 
@@ -189,8 +193,8 @@ def cast_value(ty: Optional[A.Ty], v: Any, structs: Dict[str, StructDef],
             return xp.asarray(v).astype(np.uint8) & np.uint8(1)
         if ty.name in _CPLX and fx_is_pair(v):
             # fx pair -> float complex (the f32 interop cast, e.g. FFT)
-            a = xp.asarray(v, np.float32)
-            return (a[..., 0] + 1j * a[..., 1]).astype(dt)
+            from ziria_tpu.ops.cplx import to_complex
+            return to_complex(v, xp).astype(dt)
         return xp.asarray(v).astype(dt)
     if isinstance(ty, A.TArr):
         if fxp and isinstance(ty.elem, A.TBase) \
@@ -367,6 +371,10 @@ class Ctx:
     # the call boundary and complex16 returns requantize, so f32 bricks
     # like v_fft keep their documented f32 interior
     ext_sigs: Dict[str, Any] = field(default_factory=dict)
+    # per-node memo for _fx_ty_hint (declared types are static per
+    # program point; the hint walk must not run per stream item in the
+    # interpreter hot loop)
+    fx_hints: Dict[int, Any] = field(default_factory=dict)
 
     def static_eval(self, e: A.Expr, scope: Optional[Scope] = None) -> Any:
         """Evaluate `e` and require a static Python value (array lengths,
@@ -656,8 +664,13 @@ def eval_expr(e: A.Expr, scope: Scope, ctx: Ctx) -> Any:
         raise _rt_err(e.loc, f"unknown unary {e.op!r}")
     if isinstance(e, A.EBin):
         fxp = ctx.fxp_complex16
-        if fxp and _fx_ty_hint(e, scope) is False:
-            fxp = False       # declared non-complex: stay elementwise
+        if fxp:
+            memo = ctx.fx_hints.get(id(e))
+            if memo is None or memo[0] is not e:
+                memo = (e, _fx_ty_hint(e, scope))
+                ctx.fx_hints[id(e)] = memo
+            if memo[1] is False:
+                fxp = False   # declared non-complex: stay elementwise
         return _binop(e.op, eval_expr(e.a, scope, ctx),
                       eval_expr(e.b, scope, ctx), e.loc, fxp=fxp)
     if isinstance(e, A.ECond):
@@ -754,9 +767,8 @@ def _fx_ext_arg(v: Any, ty) -> Any:
     f32 is retained only inside explicitly complex-typed ext bricks
     such as v_fft)."""
     if _ty_is_cplx(ty) and fx_is_pair(v):
-        xp = np if _np_ok(v) else _jnp()
-        a = xp.asarray(v, np.float32)
-        return (a[..., 0] + 1j * a[..., 1]).astype(np.complex64)
+        from ziria_tpu.ops.cplx import to_complex
+        return to_complex(v, np if _np_ok(v) else _jnp())
     return v
 
 
