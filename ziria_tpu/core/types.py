@@ -93,9 +93,11 @@ def unify(a: TVar, b: TVar) -> None:
         return
     if ra.dtype is not None and rb.dtype is not None \
             and _dtype_class(ra.dtype) != _dtype_class(rb.dtype):
+        # site-neutral message: the caller (Pipe/Branch/Bind) adds the
+        # composition context — unify itself cannot know which side
+        # produces and which consumes
         raise ZiriaTypeError(
-            f"stream item dtype mismatch: a stage producing "
-            f"{ra.dtype!r} items feeds a stage consuming {rb.dtype!r}")
+            f"stream item dtype mismatch: {ra.dtype!r} vs {rb.dtype!r}")
     if rb.dtype is None:
         rb.dtype = ra.dtype
     ra._parent = rb
@@ -167,8 +169,12 @@ def typecheck(comp: ir.Comp) -> SType:
                 "there is no control value to bind); wrap a finite "
                 "prefix with take/for instead")
         t2 = typecheck(comp.rest)
-        unify(t1.a, t2.a)
-        unify(t1.b, t2.b)
+        try:
+            unify(t1.a, t2.a)
+            unify(t1.b, t2.b)
+        except ZiriaTypeError as e:
+            raise _err(comp, f"{e} (both halves of a bind read/write "
+                             f"the same streams)") from None
         return type(t2)(t2.a, t2.b)
 
     if isinstance(comp, ir.LetRef):
@@ -206,8 +212,12 @@ def typecheck(comp: ir.Comp) -> SType:
             raise _err(
                 comp, f"branch arms disagree: then-arm is a {t1.kind()}, "
                 f"else-arm is a {t2.kind()}")
-        unify(t1.a, t2.a)
-        unify(t1.b, t2.b)
+        try:
+            unify(t1.a, t2.a)
+            unify(t1.b, t2.b)
+        except ZiriaTypeError as e:
+            raise _err(comp, f"{e} (branch arms must stream the same "
+                             f"item types)") from None
         return type(t1)(t1.a, t1.b)
 
     if isinstance(comp, (ir.Pipe, ir.ParPipe)):
@@ -215,7 +225,8 @@ def typecheck(comp: ir.Comp) -> SType:
         try:
             unify(t1.b, t2.a)  # up's output items feed down's input
         except ZiriaTypeError as e:
-            raise _err(comp, str(e)) from None
+            raise _err(comp, f"{e} (upstream output feeding downstream "
+                             f"input)") from None
         if isinstance(t1, CTy) and isinstance(t2, CTy):
             raise _err(
                 comp, "both sides of >>> are computers; at most one side "

@@ -126,15 +126,20 @@ def fx_is_pair(v: Any) -> bool:
 def fx_wrap16(v):
     """Wrap components to int16 range, keep int32 storage (the C shorts
     store-narrowing, without losing the promoted width for the next
-    operation). Floats round to int64 first and wrap MODULARLY —
-    astype(int16) on an out-of-range float is implementation-defined
-    under XLA (saturates) but wraps under numpy, which would break the
-    interp == jit invariant (review r2)."""
+    operation). Floats wrap MODULARLY via fmod in the float domain —
+    exact for every representable float (fmod is exact, and the result
+    is an integer < 2^17, exactly representable), identical on numpy
+    and XLA, and needing no int64 (which JAX silently truncates to
+    int32 with x64 off — review r2). astype(int16) on out-of-range
+    floats would saturate under XLA but wrap under numpy, breaking the
+    interp == jit invariant."""
     xp = np if _np_ok(v) else _jnp()
     x = xp.asarray(v)
     if not np.issubdtype(np.dtype(x.dtype), np.integer):
-        x = xp.round(x).astype(np.int64)
-        return (((x + 32768) % 65536) - 32768).astype(np.int32)
+        r = xp.fmod(xp.round(x), 65536.0)      # (-65536, 65536), exact
+        r = xp.where(r >= 32768.0, r - 65536.0, r)
+        r = xp.where(r < -32768.0, r + 65536.0, r)
+        return r.astype(np.int32)
     return x.astype(np.int16).astype(np.int32)
 
 

@@ -1,10 +1,15 @@
 from ziria_tpu.parallel.batch import data_parallel, frame_mesh, shard_batch
+from ziria_tpu.parallel.multihost import (build_mesh, init_multihost,
+                                          mesh_info)
 from ziria_tpu.parallel.stages import PPLowered, lower_stage_parallel
 
 __all__ = [
     "PPLowered",
+    "build_mesh",
     "data_parallel",
     "frame_mesh",
+    "init_multihost",
     "lower_stage_parallel",
+    "mesh_info",
     "shard_batch",
 ]

@@ -1,0 +1,112 @@
+"""Multi-host scale-out: process bring-up + DCN/ICI-aware meshes.
+
+The reference has NO distributed backend — all cross-stage traffic is
+shared-memory SPSC queues on one machine (SURVEY.md §2.5). This module
+is the new framework's equivalent of what an NCCL/MPI layer would be,
+done the TPU way: ``jax.distributed`` brings up the multi-process
+runtime over DCN, and mesh construction lays the parallel axes out so
+the *latency-sensitive* axis rides ICI while the *embarrassingly
+parallel* axis crosses DCN:
+
+- ``pp`` (stage parallelism, ``|>>>|``) moves a chunk between adjacent
+  stages via ``ppermute`` every macro step — it must live on ICI
+  (within a host/slice), or every stream item pays a network hop;
+- ``dp`` (frame batching) has NO steady-state collectives (shards are
+  independent until the host gather), so it is the axis that can span
+  hosts over DCN for free.
+
+``build_mesh`` encodes that policy: single-process it defers to
+``mesh_utils.create_device_mesh`` (which optimizes ICI adjacency);
+multi-process it uses ``create_hybrid_device_mesh`` with the dp axis
+on the DCN dimension. The same (dp, pp) mesh then drives
+``parallel.batch`` and ``parallel.stages`` unchanged — the collectives
+are inserted by XLA from the shardings, never hand-written.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   auto: bool = False,
+                   **kwargs) -> bool:
+    """Bring up the multi-process JAX runtime (DCN).
+
+    Three modes:
+    - no arguments: safe NO-OP (returns False) — the single-process
+      dev/test case never touches the backend;
+    - ``auto=True``: call ``jax.distributed.initialize()`` with no
+      arguments and let JAX auto-detect the cluster from the
+      environment (the TPU-pod path);
+    - explicit coordinator/num_processes/process_id: CPU/GPU clusters.
+
+    Counterpart of the reference's (nonexistent) NCCL/MPI init — the
+    rest of the framework never sees processes, only the global device
+    list."""
+    if not auto and num_processes in (None, 1) \
+            and coordinator_address is None:
+        return False
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id, **kwargs)
+    return True
+
+
+def build_mesh(dp: int = 1, pp: int = 1,
+               axis_names: Tuple[str, str] = ("dp", "pp"),
+               devices: Optional[Sequence] = None) -> Mesh:
+    """A (dp, pp) mesh with DCN/ICI-aware layout (see module doc).
+
+    dp*pp devices are used. Multi-process: dp spans the process (DCN)
+    dimension — it must be a multiple of the process count; pp stays
+    inside each process's ICI domain. Single-process: the mesh comes
+    from create_device_mesh, which orders devices for ICI adjacency on
+    real TPU topologies (and is a plain reshape on CPU/virtual
+    devices)."""
+    import jax
+    from jax.experimental import mesh_utils
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = dp * pp
+    if len(devices) < n:
+        raise ValueError(
+            f"build_mesh(dp={dp}, pp={pp}) needs {n} devices; "
+            f"{len(devices)} visible")
+    devices = devices[:n]
+    n_proc = len({d.process_index for d in devices})
+    if n_proc > 1:
+        if dp % n_proc:
+            raise ValueError(
+                f"dp={dp} must be a multiple of the process count "
+                f"({n_proc}): dp is the axis that crosses DCN; pp "
+                f"must stay inside one host's ICI domain")
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(dp // n_proc, pp),
+            dcn_mesh_shape=(n_proc, 1),
+            devices=devices)
+    else:
+        arr = mesh_utils.create_device_mesh((dp, pp), devices=devices)
+    return Mesh(np.asarray(arr), axis_names)
+
+
+def mesh_info(mesh: Mesh) -> dict:
+    """Inspectable layout summary (which axis crosses processes)."""
+    devs = np.asarray(mesh.devices)
+    procs = np.vectorize(lambda d: d.process_index)(devs)
+    return {
+        "shape": dict(zip(mesh.axis_names, devs.shape)),
+        "n_processes": int(len(np.unique(procs))),
+        # an axis is DCN-crossing if process_index varies along it
+        "dcn_axes": [
+            name for k, name in enumerate(mesh.axis_names)
+            if np.any(np.diff(procs, axis=k) != 0)
+        ],
+    }
