@@ -1,0 +1,143 @@
+"""Validate the vectorizer's utility model against TPU measurement.
+
+VERDICT r1 next-round #5: the model (core/vectorize.py STEP_OVERHEAD /
+VPU_PARALLEL) picked widths no measurement had ever contacted. This
+harness times representative pipelines at W in {pick/4, pick, 4*pick}
+on the real chip using the device-loop marginal method (see bench.py:
+per-call timing measures the axon tunnel, not the chip) and reports
+whether the model's pick is within tolerance of the measured best.
+
+    python tools/calibrate_vect.py            # needs the TPU reachable
+    python tools/calibrate_vect.py --cpu      # smoke-test the harness
+
+Emits one JSON object: per-pipeline tables of (W, steps/s, items/s)
+plus the model's pick and the measured best. If the pick is >10% off
+the best W's throughput, recalibrate STEP_OVERHEAD (raise it if the
+model picks too-small W; lower if too-large) and re-run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+def _pipelines():
+    """(name, comp, item dtype) — one stateless-wide, one stateful-
+    scan-bound, one mixed (the three regimes the model trades off)."""
+    import ziria_tpu as z
+
+    def fir_step(s, x):
+        import jax.numpy as jnp
+        s = jnp.roll(s, 1).at[0].set(x)
+        return s, (s * jnp.arange(1.0, 6.0)).sum()
+
+    stateless = z.pipe(z.zmap(lambda x: x * 2.0 + 1.0, name="axpy"),
+                       z.zmap(lambda x: x * x, name="sq"))
+    stateful = z.pipe(z.map_accum(fir_step, np.zeros(5, np.float32),
+                                  name="fir5"))
+    mixed = z.pipe(z.zmap(lambda x: x * 0.5, name="pre"),
+                   z.map_accum(lambda s, x: (s + x, s + x), 0.0,
+                               name="cumsum"),
+                   z.zmap(lambda x: x + 3.0, name="post"))
+    return [("stateless", stateless), ("stateful", stateful),
+            ("mixed", mixed)]
+
+
+def _fence(x):
+    np.asarray(x.ravel()[:1])
+
+
+def _time_width(comp, W: int, n_items: int = 1 << 16) -> float:
+    """Marginal seconds per fused step at width W via a device-side
+    chain of K steps (cancels the tunnel round-trip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ziria_tpu.backend.lower import lower
+
+    lowered = lower(comp, width=W)
+    take = lowered.ss.take * W
+    xs = jnp.asarray(
+        np.random.default_rng(0).normal(size=take).astype(np.float32))
+
+    @jax.jit
+    def step_k(x0, k):
+        def body(i, carry):
+            s, x, acc = carry
+            st, y = lowered.step(s, x)
+            # feed a perturbed copy of the same chunk back: keeps the
+            # loop data-dependent so XLA cannot hoist the body
+            return (st, x0 + acc * 1e-30, acc + y.sum())
+        return jax.lax.fori_loop(
+            0, k, body, (lowered.init_carry["stages"]
+                         if isinstance(lowered.init_carry, dict)
+                         else lowered.init_carry, x0, jnp.float32(0)))[2]
+
+    K1, K2 = 16, 80
+    def run(k):
+        best = float("inf")
+        _fence(step_k(xs, jnp.int32(k)))
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _fence(step_k(xs, jnp.int32(k)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+    t1, t2 = run(K1), run(K2)
+    return max((t2 - t1) / (K2 - K1), 1e-9)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="harness smoke test on CPU")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+
+    from ziria_tpu.core.vectorize import vectorize
+
+    report = {"device": str(dev), "pipelines": {}}
+    for name, comp in _pipelines():
+        plan = vectorize(comp)
+        pick = plan.segments[0].width if plan.segments else 1
+        table = []
+        for W in sorted({max(1, pick // 4), pick, pick * 4}):
+            t = _time_width(comp, W)
+            lowered_items = None
+            from ziria_tpu.backend.lower import lower
+            take = lower(comp, width=W).ss.take * W
+            table.append({"W": W, "s_per_step": round(t, 9),
+                          "items_per_s": round(take / t, 1)})
+        best = max(table, key=lambda r: r["items_per_s"])
+        pick_row = next(r for r in table if r["W"] == pick)
+        report["pipelines"][name] = {
+            "model_pick": pick,
+            "table": table,
+            "best_W": best["W"],
+            "pick_within_10pct":
+                pick_row["items_per_s"] >= 0.9 * best["items_per_s"],
+        }
+    print(json.dumps(report, indent=2))
+    ok = all(p["pick_within_10pct"]
+             for p in report["pipelines"].values())
+    print(("MODEL OK: every pick within 10% of measured best"
+           if ok else
+           "MODEL OFF: recalibrate STEP_OVERHEAD/VPU_PARALLEL "
+           "(core/vectorize.py)"), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
