@@ -45,8 +45,9 @@ def _impaired_capture(mbps: int, n_bytes: int, seed: int,
     return psdu, xi
 
 
-@pytest.mark.parametrize("mbps,n_bytes", [(6, 30), (12, 40), (24, 60),
-                                          (54, 90)])
+@pytest.mark.parametrize("mbps,n_bytes", [(6, 30), (9, 33), (12, 40),
+                                          (18, 45), (24, 60), (36, 70),
+                                          (48, 81), (54, 90)])
 def test_wifi_rx_zir_matches_receive(mbps, n_bytes):
     psdu, xi = _impaired_capture(mbps, n_bytes, seed=mbps)
     res = rx.receive(xi.astype(np.float32))

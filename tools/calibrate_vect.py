@@ -9,6 +9,10 @@ whether the model's pick is within tolerance of the measured best.
 
     python tools/calibrate_vect.py            # needs the TPU reachable
     python tools/calibrate_vect.py --cpu      # smoke-test the harness
+                                              # (mechanics only: the
+                                              # constants are TPU-tuned,
+                                              # so a CPU verdict of
+                                              # MODEL OFF is expected)
 
 Emits one JSON object: per-pipeline tables of (W, steps/s, items/s)
 plus the model's pick and the measured best. If the pick is >10% off
@@ -56,16 +60,17 @@ def _fence(x):
     np.asarray(x.ravel()[:1])
 
 
-def _time_width(comp, W: int, n_items: int = 1 << 16) -> float:
-    """Marginal seconds per fused step at width W via a device-side
-    chain of K steps (cancels the tunnel round-trip)."""
+def _time_width(comp, W: int):
+    """(marginal seconds per fused step at width W, items per step) —
+    timed via a device-side chain of K steps (cancels the tunnel
+    round-trip)."""
     import jax
     import jax.numpy as jnp
 
     from ziria_tpu.backend.lower import lower
 
     lowered = lower(comp, width=W)
-    take = lowered.ss.take * W
+    take = lowered.take
     xs = jnp.asarray(
         np.random.default_rng(0).normal(size=take).astype(np.float32))
 
@@ -78,9 +83,7 @@ def _time_width(comp, W: int, n_items: int = 1 << 16) -> float:
             # loop data-dependent so XLA cannot hoist the body
             return (st, x0 + acc * 1e-30, acc + y.sum())
         return jax.lax.fori_loop(
-            0, k, body, (lowered.init_carry["stages"]
-                         if isinstance(lowered.init_carry, dict)
-                         else lowered.init_carry, x0, jnp.float32(0)))[2]
+            0, k, body, (lowered.init_carry, x0, jnp.float32(0)))[2]
 
     K1, K2 = 16, 80
     def run(k):
@@ -92,7 +95,7 @@ def _time_width(comp, W: int, n_items: int = 1 << 16) -> float:
             best = min(best, time.perf_counter() - t0)
         return best
     t1, t2 = run(K1), run(K2)
-    return max((t2 - t1) / (K2 - K1), 1e-9)
+    return max((t2 - t1) / (K2 - K1), 1e-9), take
 
 
 def main() -> int:
@@ -114,10 +117,7 @@ def main() -> int:
         pick = plan.segments[0].width if plan.segments else 1
         table = []
         for W in sorted({max(1, pick // 4), pick, pick * 4}):
-            t = _time_width(comp, W)
-            lowered_items = None
-            from ziria_tpu.backend.lower import lower
-            take = lower(comp, width=W).ss.take * W
+            t, take = _time_width(comp, W)
             table.append({"W": W, "s_per_step": round(t, 9),
                           "items_per_s": round(take / t, 1)})
         best = max(table, key=lambda r: r["items_per_s"])
@@ -136,7 +136,7 @@ def main() -> int:
            if ok else
            "MODEL OFF: recalibrate STEP_OVERHEAD/VPU_PARALLEL "
            "(core/vectorize.py)"), file=sys.stderr)
-    return 0
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
