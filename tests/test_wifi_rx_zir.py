@@ -95,3 +95,27 @@ def test_wifi_rx_zir_bad_header_emits_nothing():
     prog = compile_file(SRC)
     out = run(prog.comp, [p for p in xi]).out_array()
     assert out.size == 0
+
+
+def test_wifi_tx_full_zir_matches_encode_frame():
+    """The COMPLETE transmitter as a program of the framework
+    (examples/wifi_tx_full.zir): preamble + SIGNAL + DATA symbols must
+    equal phy/wifi/tx.encode_frame within 1 LSB at quantization scale
+    512 — the TX-side dual of the in-language receiver."""
+    src = os.path.join(os.path.dirname(__file__), "..", "examples",
+                       "wifi_tx_full.zir")
+    rng = np.random.default_rng(21)
+    psdu = rng.integers(0, 256, 100).astype(np.uint8)
+    bits = np.asarray(bytes_to_bits(psdu)).astype(np.uint8)
+
+    prog = compile_file(src)
+    out = np.asarray(run(prog.comp, list(bits)).out_array())
+    want = np.round(np.asarray(tx.encode_frame(psdu, 6)) * 512.0)
+    assert out.shape == want.shape
+    assert np.abs(out - want).max() <= 1.0
+
+    # and the in-language RECEIVER decodes the in-language TRANSMITTER:
+    # the full PHY loop entirely as programs of the framework
+    res = rx.receive(out.astype(np.float32) / 512.0, max_samples=4096)
+    assert res.ok and res.rate_mbps == 6 and res.length_bytes == 100
+    np.testing.assert_array_equal(res.psdu_bits, bits)

@@ -136,7 +136,9 @@ def main() -> int:
            if ok else
            "MODEL OFF: recalibrate STEP_OVERHEAD/VPU_PARALLEL "
            "(core/vectorize.py)"), file=sys.stderr)
-    return 0 if ok else 1
+    # --cpu is a mechanics smoke test: the constants are TPU-tuned, so
+    # its verdict is expected to be OFF and must not fail the exit code
+    return 0 if (ok or args.cpu) else 1
 
 
 if __name__ == "__main__":
