@@ -1,0 +1,257 @@
+"""AutoLUT *inference* (frontend/lutinfer.py — the reference's
+LUTAnalysis role, SURVEY.md §2.1): pure surface functions with small
+total input bit-width are auto-detected and tabulated, both in `map f`
+position (packed multi-bit items like `arr[8] bit`) and at expression
+call sites staged under jit (`--autolut`). The flag-invariance
+discipline applies: LUT'd and direct programs must agree exactly."""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.core import ir
+from ziria_tpu.core.autolut import autolut
+from ziria_tpu.frontend import compile_source
+from ziria_tpu.frontend import lutinfer
+from ziria_tpu.interp.interp import run
+
+
+def _maps(comp):
+    out = []
+
+    def walk(c):
+        if isinstance(c, ir.Map):
+            out.append(c)
+        ir.map_children(c, lambda ch, _b: (walk(ch), ch)[1])
+
+    walk(comp)
+    return out
+
+
+PACK8 = """
+fun pack8(b: arr[8] bit) : int8 {
+  var v: int8 := 0;
+  for i in [0, 8] { v := v + (int8(b[i]) << int8(i)) }
+  return v
+}
+let comp main = read[bit] >>> map pack8 >>> write[int8]
+"""
+
+
+def test_map_arr_bit_inferred_and_exact():
+    prog = compile_source(PACK8)
+    m = [m for m in _maps(prog.comp) if m.label() == "pack8"]
+    assert m and m[0].in_domain is None and m[0].lut is not None
+    assert m[0].lut.domain == 256
+    xs = np.random.default_rng(0).integers(0, 2, 8 * 32).astype(np.uint8)
+    want = np.asarray(run_jit(prog.comp, xs))
+    lutted = autolut(prog.comp)
+    assert any(mm.label().startswith("lut[") for mm in _maps(lutted))
+    got = np.asarray(run_jit(lutted, xs))
+    np.testing.assert_array_equal(got, want)
+    # interpreter on the LUT'd program agrees too
+    got_i = run(lutted, list(xs)).out_array()
+    np.testing.assert_array_equal(np.asarray(got_i), want)
+
+
+def test_map_int16_inferred_domain():
+    prog = compile_source("""
+      fun nib(x: int16) : int16 { return (x >> int16(4)) & int16(0xF) }
+      let comp main = read[int16] >>> map nib >>> write[int16]
+    """)
+    m = [m for m in _maps(prog.comp) if m.label() == "nib"][0]
+    assert m.lut is not None and m.lut.domain == 65536
+
+
+MIX = """
+fun mix(x: int8, b: bit) : int8 {
+  var r: int8 := x + int8(3);
+  if b == 1 then { r := x ^ int8(0x5A) }
+  return r
+}
+let comp main = read[int8]
+  >>> repeat { x <- take; b <- take; emit mix(int8(x), bit(b & 1)) }
+  >>> write[int8]
+"""
+
+
+def test_expr_call_lut_matches_direct():
+    xs = np.random.default_rng(1).integers(-128, 128, 64).astype(np.int8)
+    direct = compile_source(MIX)
+    lut = compile_source(MIX, autolut=True)
+    want = np.asarray(run_jit(direct.comp, xs))
+    got = np.asarray(run_jit(lut.comp, xs))
+    np.testing.assert_array_equal(got, want)
+
+
+RETIF = """
+fun sel(x: int8, b: bit) : int8 {
+  if b == 1 then { return x ^ int8(0x5A) } else { return x + int8(3) }
+}
+let comp main = read[int8]
+  >>> repeat { x <- take; b <- take; emit sel(int8(x), bit(b & 1)) }
+  >>> write[int8]
+"""
+
+
+def test_lut_enables_return_in_dynamic_if():
+    # `return` inside a data-dependent if cannot stage under jit — but
+    # the LUT build's concrete-evaluation fallback sidesteps staging
+    # entirely (as the reference's compile-time LUT generation did), so
+    # with --autolut the program compiles and matches the interpreter
+    from ziria_tpu.frontend.eval import ZiriaRuntimeError
+    xs = np.random.default_rng(2).integers(-128, 128, 64).astype(np.int8)
+    direct = compile_source(RETIF)
+    with pytest.raises(ZiriaRuntimeError):
+        run_jit(direct.comp, xs)
+    want = run(direct.comp, list(xs)).out_array()   # interpreter oracle
+    lut = compile_source(RETIF, autolut=True)
+    got = np.asarray(run_jit(lut.comp, xs))
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_expr_call_lut_table_actually_used():
+    lut = compile_source(MIX, autolut=True)
+    xs = np.arange(-16, 16, dtype=np.int8)
+    run_jit(lut.comp, xs)
+    # find the Ctx through the elaborated map closure is awkward; the
+    # spec memo lives on the program's shared Ctx — reach it via any
+    # FunDef captured in a Map/closure is not exposed, so recompile and
+    # drive the evaluator directly instead
+    from ziria_tpu.frontend.elab import Elaborator
+    from ziria_tpu.frontend.parser import parse_program
+    el = Elaborator(parse_program(MIX, "<mix>"), "<mix>",
+                    autolut=True)
+    cp = el.build("main")
+    run_jit(cp.comp, xs)
+    assert "mix" in el.ctx.lut_tables          # table built
+    assert el.ctx.lut_specs["mix"] is not None  # verdict memoized
+    tab = el.ctx.lut_tables["mix"]
+    assert tab.shape[0] == 512                 # 8 + 1 bits packed
+
+
+def test_static_args_stay_direct():
+    # all-static calls fold at elaboration; no table should be built
+    from ziria_tpu.frontend.elab import Elaborator
+    from ziria_tpu.frontend.parser import parse_program
+    el = Elaborator(parse_program(MIX, "<mix>"), "<mix>", autolut=True)
+    el.build("main")
+    assert "mix" not in el.ctx.lut_tables
+
+
+@pytest.mark.parametrize("src,reason", [
+    ("""
+     fun shout(x: int8) : int8 { println "x"; return x }
+     let comp main = read[int8] >>> map shout >>> write[int8]
+     """, "print is impure"),
+    ("""
+     fun wide(x: int32) : int32 { return x + 1 }
+     let comp main = read[int32] >>> map wide >>> write[int32]
+     """, "int32 exceeds the bit-width cap"),
+    ("""
+     fun big(b: arr[24] bit) : int32 { return 1 }
+     let comp main = read[bit] >>> map big >>> write[int32]
+     """, "24 bits > MAX_LUT_BITS"),
+])
+def test_not_lutable(src, reason):
+    prog = compile_source(src)
+    for m in _maps(prog.comp):
+        assert m.lut is None, reason
+
+
+def test_recursive_fun_rejected():
+    # no surface recursion exists (funs see only earlier decls), so
+    # drive the analysis directly with a self-calling body
+    from ziria_tpu.frontend.elab import Elaborator
+    from ziria_tpu.frontend.parser import parse_program
+    el = Elaborator(parse_program("""
+      fun f(x: int8) : int8 { return f(x) }
+      let comp main = read[int8] >>> map f >>> write[int8]
+    """, "<rec>"), "<rec>")
+    el.elaborate()
+    fd = el.ctx.funs["f"]
+    assert lutinfer.spec_for_fun("f", fd, el.ctx) is None
+
+
+def test_closure_constant_baked():
+    src = """
+    let key = 0x33
+    fun enc(x: int8) : int8 { return x ^ int8(key) }
+    let comp main = read[int8] >>> map enc >>> write[int8]
+    """
+    prog = compile_source(src)
+    m = [m for m in _maps(prog.comp) if m.label() == "enc"][0]
+    # int8 scalar params already carry a declared in_domain (round-1
+    # path); the closure-constant read must not block LUT-ability when
+    # the analysis is consulted directly
+    from ziria_tpu.frontend.elab import Elaborator
+    from ziria_tpu.frontend.parser import parse_program
+    el = Elaborator(parse_program(src, "<enc>"), "<enc>")
+    el.elaborate()
+    spec = lutinfer.spec_for_fun("enc", el.ctx.funs["enc"], el.ctx)
+    assert spec is not None and spec.domain == 256
+    xs = np.arange(-128, 128, dtype=np.int8)
+    want = np.asarray(run_jit(prog.comp, xs))
+    got = np.asarray(run_jit(autolut(prog.comp), xs))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_oversize_output_table_falls_back_to_direct_call():
+    # 16-bit domain passes the bit cap, but x 512-element output rows
+    # the table would exceed MAX_TABLE_ITEMS — the call site must fall
+    # back to the direct call (and memoize the refusal), not bake a
+    # multi-MB constant into the graph
+    src = """
+    fun spread(x: int16) : arr[512] int16 {
+      var v: arr[512] int16;
+      for i in [0, 512] { v[i] := x + int16(i) }
+      return v
+    }
+    let comp main = read[int16]
+      >>> repeat { x <- take; emits spread(int16(x)) }
+      >>> write[int16]
+    """
+    from ziria_tpu.frontend.elab import Elaborator
+    from ziria_tpu.frontend.parser import parse_program
+    el = Elaborator(parse_program(src, "<sp>"), "<sp>", autolut=True)
+    cp = el.build("main")
+    xs = np.array([1, 2], np.int16)
+    out = np.asarray(run_jit(cp.comp, xs))
+    want = np.concatenate([v + np.arange(512) for v in xs]).astype(np.int16)
+    np.testing.assert_array_equal(out, want)
+    assert "spread" not in el.ctx.lut_tables
+    assert el.ctx.lut_specs.get("spread", "absent") is None  # memoized no
+
+
+def test_oversize_map_left_unlutted():
+    # same oversize function in `map` position: the autolut pass must
+    # leave the map un-LUT'd (instant upfront refusal), not crash
+    src = """
+    fun spread(x: int16) : arr[512] int16 {
+      var v: arr[512] int16;
+      for i in [0, 512] { v[i] := x + int16(i) }
+      return v
+    }
+    let comp main = read[int16] >>> map spread >>> write[int16]
+    """
+    prog = compile_source(src)
+    m = [m for m in _maps(prog.comp) if m.label() == "spread"][0]
+    assert m.lut is not None                    # inferred LUT-able...
+    lutted = autolut(prog.comp)
+    labels = [mm.label() for mm in _maps(lutted)]
+    assert "spread" in labels                   # ...but left direct
+    assert not any(l.startswith("lut[") for l in labels)
+
+
+def test_multiarg_packing_roundtrip():
+    spec = lutinfer.LutSpec("f", (
+        lutinfer.ArgSpec("x", "int8", 8),
+        lutinfer.ArgSpec("b", "bit", 1),
+        lutinfer.ArgSpec("v", "arr_bit", 4, 4),
+    ))
+    assert spec.total_bits == 13 and spec.domain == 8192
+    import jax.numpy as jnp
+    for idx in (0, 1, 777, 8191):
+        vals = lutinfer.decode_index(spec, idx)
+        back = int(lutinfer.encode_args(spec, vals))
+        assert back == idx, (idx, vals, back)

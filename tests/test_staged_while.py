@@ -1,0 +1,134 @@
+"""Dynamic (data-dependent) `while` under the jit backend: staged as
+`lax.while_loop` over the mutable cells in scope (eval._staged_while).
+Round 1 confined dynamic while to the interpreter; the reference
+compiles it to a C while loop (SURVEY.md §0 statement forms), so the
+flag-matrix discipline now applies to it too."""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.frontend import compile_source
+from ziria_tpu.frontend.eval import ZiriaRuntimeError
+from ziria_tpu.interp.interp import run
+
+
+def both(src, xs, **kw):
+    prog = compile_source(src, **kw)
+    want = run(prog.comp, list(np.asarray(xs))).out_array()
+    got = np.asarray(run_jit(prog.comp, xs))
+    np.testing.assert_array_equal(got, np.asarray(want))
+    return got
+
+
+ILOG = """
+fun ilog2(x: int32) : int32 {
+  var v: int32 := x;
+  var n: int32 := 0;
+  while (v > 1) { v := v >> 1; n := n + 1 }
+  return n
+}
+let comp main = read[int32] >>> map ilog2 >>> write[int32]
+"""
+
+
+def test_while_per_item_under_jit():
+    xs = np.array([1, 2, 3, 4, 7, 8, 1000, 65536], np.int32)
+    got = both(ILOG, xs)
+    np.testing.assert_array_equal(got, np.floor(np.log2(xs)).astype(np.int32))
+
+
+def test_while_traced_condition_in_do_block():
+    # collatz step count, bounded: loop state = two stream-level vars
+    src = """
+    let comp main = read[int32] >>> repeat {
+      x <- take;
+      var v: int32 := x;
+      var n: int32 := 0;
+      do {
+        while (v != 1 && n < 64) {
+          if v % 2 == 0 then { v := v / 2 } else { v := 3 * v + 1 };
+          n := n + 1
+        }
+      };
+      emit n
+    } >>> write[int32]
+    """
+    xs = np.array([1, 2, 3, 6, 7, 27], np.int32)
+    got = both(src, xs)
+    # python oracle
+    def collatz(v):
+        n = 0
+        while v != 1 and n < 64:
+            v = v // 2 if v % 2 == 0 else 3 * v + 1
+            n += 1
+        return n
+    np.testing.assert_array_equal(got, [collatz(int(v)) for v in xs])
+
+
+def test_while_carries_narrow_dtype():
+    # int16 counter must stay int16 across iterations (entry-dtype pin)
+    src = """
+    fun count(x: int16) : int16 {
+      var n: int16 := 0;
+      var v: int16 := x;
+      while (v > 0) { v := v - int16(1); n := n + int16(1) }
+      return n
+    }
+    let comp main = read[int16] >>> map count >>> write[int16]
+    """
+    xs = np.array([0, 1, 5, 100], np.int16)
+    got = both(src, xs)
+    np.testing.assert_array_equal(got, xs.clip(min=0))
+
+
+def test_while_static_prefix_then_traced():
+    # the loop runs concretely until the condition becomes traced —
+    # staging may start mid-loop and must still agree with the oracle
+    src = """
+    let comp main = read[int32] >>> repeat {
+      x <- take;
+      var i: int32 := 0;
+      var acc: int32 := 0;
+      do {
+        while (i < 3 || acc < x) { acc := acc + i; i := i + 1 }
+      };
+      emit acc
+    } >>> write[int32]
+    """
+    xs = np.array([0, 1, 10, 40], np.int32)
+    both(src, xs)
+
+
+def test_non_scalar_condition_diagnosed():
+    # an array-valued condition is a condition bug, not a staging
+    # situation — both backends must say so, not misreport carry shapes
+    src = """
+    fun f(v: arr[4] int32) : int32 {
+      var n: int32 := 0;
+      while (v > 0) { n := n + 1 }
+      return n
+    }
+    let comp main = read[int32] >>> repeat { x <- takes 4; emit f(x) }
+      >>> write[int32]
+    """
+    prog = compile_source(src)
+    xs = np.arange(8, dtype=np.int32)
+    with pytest.raises(ZiriaRuntimeError, match="scalar"):
+        run(prog.comp, list(xs))
+    with pytest.raises(ZiriaRuntimeError, match="scalar"):
+        run_jit(prog.comp, xs)
+
+
+def test_return_inside_dynamic_while_rejected():
+    src = """
+    fun f(x: int32) : int32 {
+      var v: int32 := x;
+      while (v > 0) { return v }
+      return 0
+    }
+    let comp main = read[int32] >>> map f >>> write[int32]
+    """
+    prog = compile_source(src)
+    with pytest.raises(ZiriaRuntimeError, match="return inside"):
+        run_jit(prog.comp, np.array([1, 2], np.int32))

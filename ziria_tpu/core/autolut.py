@@ -62,8 +62,32 @@ def build_table(m: ir.Map):
 
 
 def lut_map(m: ir.Map) -> ir.Map:
-    """Rewrite one declared-domain Map into a table gather."""
+    """Rewrite one LUT-able Map into a table gather: either a declared
+    scalar in_domain, or an inferred packed-bits adapter
+    (`m.lut`, frontend/lutinfer.MapLut — the LUTAnalysis role)."""
+    import jax
     import jax.numpy as jnp
+
+    if m.lut is not None:
+        # the adapter's build enforces the item cap upfront (lutinfer.
+        # build_fun_table via eval_shape) and memoizes per function on
+        # the program Ctx; an oversize table means "leave un-LUT'd",
+        # matching the expression-call path's fallback
+        from ziria_tpu.frontend.lutinfer import TableTooLarge
+        try:
+            table = m.lut.build_table()
+        except TableTooLarge:
+            return m
+
+        enc = m.lut.encoder()      # closes over the spec only, not the
+                                   # FunDef/Ctx the adapter carries
+
+        def gather(x, _t=table, _enc=enc):
+            idx = _enc(x)
+            return jax.tree_util.tree_map(lambda t: t[idx], _t)
+
+        return ir.Map(gather, in_arity=m.in_arity, out_arity=m.out_arity,
+                      name=f"lut[{m.label()}]")
 
     table = build_table(m)
 
@@ -75,11 +99,13 @@ def lut_map(m: ir.Map) -> ir.Map:
 
 
 def autolut(comp: ir.Comp) -> ir.Comp:
-    """Rewrite every Map with a declared in_domain into its LUT form.
-    Structure-preserving everywhere else; semantics identical (tested
-    against the un-LUT'd program on both backends)."""
+    """Rewrite every Map with a declared in_domain (or an inferred
+    lutinfer adapter) into its LUT form. Structure-preserving everywhere
+    else; semantics identical (tested against the un-LUT'd program on
+    both backends)."""
     def walk(c: ir.Comp) -> ir.Comp:
-        if isinstance(c, ir.Map) and c.in_domain is not None:
+        if isinstance(c, ir.Map) and (c.in_domain is not None
+                                      or c.lut is not None):
             return lut_map(c)
         return ir.map_children(c, lambda ch, _binds: walk(ch))
 

@@ -241,12 +241,13 @@ _FILE_TY = {
 
 class Elaborator:
     def __init__(self, prog: A.Program, src_name: str = "<input>",
-                 fxp_complex16: bool = False):
+                 fxp_complex16: bool = False, autolut: bool = False):
         self.prog = prog
         self.src = src_name
         self.gscope = E.Scope()
         self.ctx = E.Ctx(exts=dict(BUILTINS),
-                         fxp_complex16=fxp_complex16)
+                         fxp_complex16=fxp_complex16,
+                         autolut=autolut)
         self.comp_funs: Dict[str, A.DFunComp] = {}
         # single source of truth for ext signatures: the evaluator's
         # registry (ctx.ext_sigs); self.ext_sigs aliases the SAME dict
@@ -512,11 +513,20 @@ class Elaborator:
             def f(x, _fd=fd, _ctx=ctx):
                 return E.call_fun(_fd, [x], _ctx)
 
+            lut = None
+            if dom is None:
+                # inferred LUT-ability (lutinfer, LUTAnalysis role):
+                # packed multi-bit items like arr[8] bit
+                from ziria_tpu.frontend import lutinfer
+                spec = lutinfer.spec_for_fun(name, fd, ctx)
+                if spec is not None:
+                    lut = lutinfer.MapLut(spec, fd, ctx)
             fxp = self.ctx.fxp_complex16
             return ir.Map(f, in_arity=a, out_arity=b, name=name,
                           in_domain=dom,
                           in_dtype=_dtype_of(d.params[0].ty, fxp),
-                          out_dtype=_dtype_of(d.ret_ty, fxp))
+                          out_dtype=_dtype_of(d.ret_ty, fxp),
+                          lut=lut)
         if name in self.ext_sigs:
             d = self.ext_sigs[name]
             fn = self.ctx.exts[name]
@@ -880,15 +890,19 @@ def _file_ty(ty: A.Ty, src: str) -> str:
 
 def compile_source(src: str, src_name: str = "<input>",
                    entry: str = "main", typecheck: bool = True,
-                   fxp_complex16: bool = False) -> CompiledProgram:
+                   fxp_complex16: bool = False,
+                   autolut: bool = False) -> CompiledProgram:
     prog = parse_program(src, src_name)
-    return Elaborator(prog, src_name, fxp_complex16=fxp_complex16) \
+    return Elaborator(prog, src_name, fxp_complex16=fxp_complex16,
+                      autolut=autolut) \
         .build(entry, typecheck=typecheck)
 
 
 def compile_file(path: str, entry: str = "main", typecheck: bool = True,
-                 fxp_complex16: bool = False) -> CompiledProgram:
+                 fxp_complex16: bool = False,
+                 autolut: bool = False) -> CompiledProgram:
     with open(path, "r") as fh:
         return compile_source(fh.read(), path, entry,
                               typecheck=typecheck,
-                              fxp_complex16=fxp_complex16)
+                              fxp_complex16=fxp_complex16,
+                              autolut=autolut)

@@ -380,6 +380,14 @@ class Ctx:
     # program point; the hint walk must not run per stream item in the
     # interpreter hot loop)
     fx_hints: Dict[int, Any] = field(default_factory=dict)
+    # AutoLUT inference (frontend/lutinfer.py, the reference's
+    # LUTAnalysis role): when `autolut` is set (CLI --autolut), calls to
+    # pure small-bit-width funs with traced arguments stage as table
+    # gathers; lut_specs memoizes per-fun verdicts and lut_tables the
+    # synthesized tables (concrete device constants, safe across traces)
+    autolut: bool = False
+    lut_specs: Dict[str, Any] = field(default_factory=dict)
+    lut_tables: Dict[str, Any] = field(default_factory=dict)
 
     def static_eval(self, e: A.Expr, scope: Optional[Scope] = None) -> Any:
         """Evaluate `e` and require a static Python value (array lengths,
@@ -809,6 +817,30 @@ def _eval_call(e: A.ECall, scope: Scope, ctx: Ctx) -> Any:
     # user expression functions
     fd = ctx.funs.get(name)
     if fd is not None:
+        if ctx.autolut and not _np_ok(*args) \
+                and len(args) == len(fd.decl.params):
+            # staged call with traced args: LUT-able pure funs become
+            # one table gather (lutinfer, the LUTAnalysis role); arity
+            # mismatches fall through to call_fun's clear error rather
+            # than zip-truncating into a wrong table index
+            from ziria_tpu.frontend import lutinfer
+            spec = lutinfer.spec_for_fun(name, fd, ctx)
+            if spec is not None:
+                table = ctx.lut_tables.get(name)
+                if table is None:
+                    try:
+                        table = lutinfer.build_fun_table(spec, fd, ctx)
+                    except lutinfer.TableTooLarge:
+                        # domain fit the bit cap but the output didn't
+                        # (e.g. int16 -> arr[256]); permanently fall
+                        # back to the direct call
+                        ctx.lut_specs[name] = None
+                        spec = None
+                    else:
+                        ctx.lut_tables[name] = table
+                if spec is not None:
+                    return lutinfer.gather(
+                        table, lutinfer.encode_args(spec, args))
         return call_fun(fd, args, ctx, e.loc)
     # ext / builtin functions
     fn = ctx.exts.get(name)
@@ -936,14 +968,17 @@ def exec_stmt(st: A.Stmt, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
     if isinstance(st, A.SWhile):
         while True:
             c = eval_expr(st.c, scope, ctx)
-            try:
-                c = bool(c)
-            except Exception:
-                raise _rt_err(
-                    st.loc, "while condition is data-dependent under "
-                            "tracing; dynamic while-loops run on the "
-                            "interpreter backend only")
-            if not c:
+            if np.size(c) != 1:
+                # concrete OR traced non-scalar: a condition bug, not a
+                # staging situation — diagnose it as such
+                raise _rt_err(st.loc,
+                              f"while condition must be a scalar "
+                              f"boolean, got shape {np.shape(c)}")
+            if not _np_ok(c):
+                # traced condition (possibly only from this iteration
+                # on): stage the rest of the loop as lax.while_loop
+                return _staged_while(st, scope, ctx)
+            if not bool(c):
                 return None
             r = exec_stmts(st.body, scope.child(), ctx)
             if r is not None:
@@ -954,6 +989,73 @@ def exec_stmt(st: A.Stmt, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
         eval_expr(st.e, scope, ctx)
         return None
     raise _rt_err(st.loc, f"unknown statement {type(st).__name__}")
+
+
+def _staged_while(st: A.SWhile, scope: Scope, ctx: Ctx):
+    """Dynamic-condition `while`: stage as `lax.while_loop` carrying
+    every mutable cell visible at the loop (round 1 restricted dynamic
+    while to the interpreter backend; the reference compiles it to a C
+    while, so the jit backend must express it too — SURVEY.md §0).
+
+    Carry discipline: each cell's value must be array-able with a
+    loop-invariant tree structure and shape; leaf dtypes are pinned to
+    their entry dtype (the same narrowing an assignment through the
+    cell's declared type performs), so `int16 i; while (...) i := i+1`
+    carries int16 even though the body's arithmetic promotes to int32.
+    """
+    import jax
+    from jax import lax
+    jnp = _jnp()
+    cells = scope.mutable_cells()
+
+    try:
+        flat0, td0 = jax.tree_util.tree_flatten(
+            [c.value for c in cells])
+        flat0 = [jnp.asarray(x) for x in flat0]
+    except Exception:
+        raise _rt_err(
+            st.loc, "while condition is data-dependent and a variable "
+                    "in scope holds a non-stageable value; run this "
+                    "program on the interpreter backend") from None
+    dts = [x.dtype for x in flat0]
+
+    def put(flat):
+        vals = jax.tree_util.tree_unflatten(td0, list(flat))
+        for c, v in zip(cells, vals):
+            c.value = v
+
+    def cond_fn(flat):
+        put(flat)
+        return jnp.asarray(eval_expr(st.c, scope, ctx)) \
+                  .astype(jnp.bool_).reshape(())
+
+    def body_fn(flat):
+        put(flat)
+        r = exec_stmts(st.body, scope.child(), ctx)
+        if r is not None:
+            raise _rt_err(st.loc, "return inside a data-dependent while "
+                                  "is not supported under staging")
+        leaves, td = jax.tree_util.tree_flatten(
+            [c.value for c in cells])
+        if td != td0:
+            raise _rt_err(
+                st.loc, "data-dependent while changes a variable's "
+                        "structure (struct fields) across iterations; "
+                        "the loop state must keep one shape")
+        return tuple(jnp.asarray(x).astype(dt)
+                     for x, dt in zip(leaves, dts))
+
+    try:
+        out = lax.while_loop(cond_fn, body_fn, tuple(flat0))
+    except ZiriaRuntimeError:
+        raise
+    except TypeError as e:
+        raise _rt_err(
+            st.loc, f"data-dependent while has a loop-varying state "
+                    f"shape ({e}); under staging every assigned "
+                    f"variable must keep its shape") from None
+    put(out)
+    return None
 
 
 def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):

@@ -189,7 +189,8 @@ def _resolve_prog(args):
     if args.src:
         from ziria_tpu.frontend import compile_file
         prog = compile_file(args.src,
-                            fxp_complex16=args.fxp_complex16)
+                            fxp_complex16=args.fxp_complex16,
+                            autolut=args.autolut)
         return prog.comp, prog.in_ty, prog.out_ty
     if not args.prog:
         raise SystemExit("need --prog=NAME or --src=FILE "
