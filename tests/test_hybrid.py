@@ -1,0 +1,168 @@
+"""Hybrid backend (backend/hybrid.py): interpreter-driven control with
+jit-compiled heavy do-blocks. The flagship DSL receiver must produce
+bit-identical output to the pure interpreter (the oracle), with its DSP
+blocks running as compiled XLA — the TPU answer to the reference
+compiling ALL of its dynamic control to C (SURVEY.md §2.1)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ziria_tpu.backend import hybrid as H
+from ziria_tpu.core import ir
+from ziria_tpu.frontend import compile_file, compile_source
+from ziria_tpu.interp.interp import run
+
+SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
+                   "wifi_rx.zir")
+
+
+def _capture(mbps, n_bytes, seed, cfo=0.002):
+    from ziria_tpu.phy import channel
+    from ziria_tpu.phy.wifi import tx
+    rng = np.random.default_rng(seed)
+    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+    frame = np.asarray(tx.encode_frame(psdu, mbps))
+    x = np.concatenate([
+        rng.normal(scale=0.02, size=(60, 2)).astype(np.float32),
+        np.asarray(channel.apply_cfo(jnp.asarray(frame), cfo)),
+        rng.normal(scale=0.02, size=(40, 2)).astype(np.float32)])
+    x = (x + rng.normal(scale=0.03, size=x.shape)).astype(np.float32)
+    xi = np.clip(np.round(x * 1024), -32768, 32767).astype(np.int16)
+    return psdu, xi
+
+
+@pytest.mark.parametrize("mbps,n_bytes", [(6, 30), (24, 60), (54, 90)])
+def test_wifi_rx_hybrid_matches_interp(mbps, n_bytes):
+    psdu, xi = _capture(mbps, n_bytes, seed=mbps)
+    prog = compile_file(SRC)
+    want = run(prog.comp, [p for p in xi]).out_array()
+    got = H.run_hybrid(prog.comp, [p for p in xi]).out_array()
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert np.asarray(want).shape[0] == 8 * n_bytes
+
+
+def test_hybrid_blocks_actually_jit():
+    # the receiver's heavy blocks must be wrapped (not silently broken):
+    # run once, then check every wrapper that fired compiled a fn and
+    # is not in fallback mode
+    psdu, xi = _capture(6, 30, seed=99)
+    hyb = H.hybridize(compile_file(SRC).comp)
+    wrappers = []
+
+    def walk(c):
+        if isinstance(c, ir.Return) and isinstance(c.expr, H._JitDo):
+            wrappers.append(c.expr)
+        ir.map_children(c, lambda ch, _b: (walk(ch), ch)[1])
+
+    walk(hyb)
+    assert len(wrappers) >= 9          # window block + 8 rate branches
+    run(hyb, [p for p in xi])
+    fired = [w for w in wrappers if w._fns]
+    assert fired, "no do-block ever reached jit"
+    assert all(not w._broken for w in fired), \
+        [w for w in fired if w._broken]
+
+
+def test_jitdo_writes_back_numpy():
+    # refs must come back as numpy so downstream per-item interpretation
+    # stays on the fast path
+    src = """
+    let comp main = read[int32] >>> repeat {
+      x <- take;
+      var acc : arr[64] int32;
+      do {
+        for k in [0, 64] {
+          var s : int32 := 0;
+          for i in [0, 32] { s := s + x * (k + i) };
+          acc[k] := s
+        }
+      };
+      emit acc[63]
+    } >>> write[int32]
+    """
+    prog = compile_source(src)
+    hyb = H.hybridize(prog.comp, min_weight=100)
+    xs = np.arange(1, 5, dtype=np.int32)
+    want = run(prog.comp, list(xs)).out_array()
+    got = H.run_hybrid(prog.comp, list(xs), min_weight=100).out_array()
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    del hyb
+
+
+def test_env_ref_shadowing_excluded():
+    from ziria_tpu.frontend.elab import _env_ref_names
+    env = ir.Env()
+    env.bind_ref("n", 1)
+    env.bind_ref("m", 2)
+    child = env.child()
+    child.bind("n", 10)               # immutable bind shadows outer ref
+    names = _env_ref_names(child)
+    assert "m" in names and "n" not in names
+
+
+def test_viterbi_soft_traced_with_static_lengths():
+    from ziria_tpu.frontend.externals import EXTERNALS
+    vs = EXTERNALS["viterbi_soft"]
+    rng = np.random.default_rng(0)
+    # encode a known 24-bit message with the 802.11 conv code via the
+    # shared tx encoder bricks is overkill here: decode of random soft
+    # values just needs jit path == numpy path
+    llrs = rng.normal(size=128).astype(np.float32)
+    want = vs(llrs, 32, 24)
+    got = jax.jit(lambda x: vs(x, 32, 24))(jnp.asarray(llrs))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_print_inside_called_fun_never_wrapped():
+    # effects hidden behind a helper fun must also block wrapping —
+    # a trace-time print would fire once instead of per firing
+    src = """
+    fun shout(x: int32) : int32 { println x; return x }
+    let comp main = read[int32] >>> repeat {
+      x <- take;
+      var s : int32 := 0;
+      do {
+        for k in [0, 64] { for i in [0, 32] { s := s + x } };
+        s := shout(s)
+      };
+      emit s
+    } >>> write[int32]
+    """
+    hyb = H.hybridize(compile_source(src).comp, min_weight=100)
+    found = []
+
+    def walk(c):
+        if isinstance(c, ir.Return) and isinstance(c.expr, H._JitDo):
+            found.append(c)
+        ir.map_children(c, lambda ch, _b: (walk(ch), ch)[1])
+
+    walk(hyb)
+    assert not found
+
+
+def test_print_blocks_never_wrapped():
+    src = """
+    let comp main = read[int32] >>> repeat {
+      x <- take;
+      do {
+        var s : int32 := 0;
+        for k in [0, 64] { for i in [0, 32] { s := s + x } };
+        println s
+      };
+      emit x
+    } >>> write[int32]
+    """
+    hyb = H.hybridize(compile_source(src).comp, min_weight=100)
+    found = []
+
+    def walk(c):
+        if isinstance(c, ir.Return) and isinstance(c.expr, H._JitDo):
+            found.append(c)
+        ir.map_children(c, lambda ch, _b: (walk(ch), ch)[1])
+
+    walk(hyb)
+    assert not found

@@ -243,6 +243,46 @@ def test_oversize_map_left_unlutted():
     assert not any(l.startswith("lut[") for l in labels)
 
 
+def test_unstageable_big_domain_left_direct():
+    # return-inside-dynamic-if + 16-bit domain: too big for the
+    # concrete per-row fallback, unstageable for the vmap build — the
+    # autolut pass must leave the map un-LUT'd (program still works
+    # exactly as without the flag), not crash the compile
+    src = """
+    fun sel16(x: int16) : int16 {
+      if x > 0 then { return x } else { return 0 - x }
+    }
+    let comp main = read[int16] >>> map sel16 >>> write[int16]
+    """
+    prog = compile_source(src)
+    lutted = autolut(prog.comp)              # must not raise
+    labels = [m.label() for m in _maps(lutted)]
+    assert "sel16" in labels and not any(
+        l.startswith("lut[") for l in labels)
+    xs = np.array([-5, -1, 0, 7], np.int16)
+    out = run(lutted, list(xs)).out_array()
+    np.testing.assert_array_equal(np.asarray(out), np.abs(xs))
+
+
+def test_bool_param_nonzero_semantics():
+    # bool packs as (v != 0), matching cast_value — a traced int 2 must
+    # hit the True row, exactly like the direct call would
+    src = """
+    fun pick(x: int8, b: bool) : int8 {
+      var r : int8 := 0 - x;
+      if b then { r := x };
+      return r
+    }
+    let comp main = read[int8]
+      >>> repeat { x <- take; emit pick(int8(x), bool(x & 2)) }
+      >>> write[int8]
+    """
+    xs = np.array([0, 1, 2, 3, 6, -2], np.int8)
+    want = np.asarray(run_jit(compile_source(src).comp, xs))
+    got = np.asarray(run_jit(compile_source(src, autolut=True).comp, xs))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_multiarg_packing_roundtrip():
     spec = lutinfer.LutSpec("f", (
         lutinfer.ArgSpec("x", "int8", 8),

@@ -100,6 +100,25 @@ def test_while_static_prefix_then_traced():
     both(src, xs)
 
 
+def test_dynamic_bound_for_under_jit():
+    # for-loop bounds computed from traced data stage as lax.fori_loop
+    # with traced bounds (the reference's C backend compiles these
+    # trivially); the interpreter needs them concrete, which they are
+    src = """
+    fun tri(x: int32) : int32 {
+      var acc : int32 := 0;
+      var n : int32 := x % 10;
+      for i in [0, n] { acc := acc + i }
+      return acc
+    }
+    let comp main = read[int32] >>> map tri >>> write[int32]
+    """
+    xs = np.array([0, 3, 7, 12, 25, 99], np.int32)
+    got = both(src, xs)
+    np.testing.assert_array_equal(
+        got, [sum(range(int(v) % 10)) for v in xs])
+
+
 def test_non_scalar_condition_diagnosed():
     # an array-valued condition is a condition bug, not a staging
     # situation — both backends must say so, not misreport carry shapes

@@ -1,0 +1,291 @@
+"""Hybrid executor: interpreter-driven stream control, jit-compiled
+do-blocks.
+
+The reference compiles EVERYTHING to C — including the dynamic control
+the fused jit backend here refuses (value-dependent branches, dynamic
+trip counts, per-item takes; SURVEY.md §2.1 CgComp's state machines).
+The TPU-native middle ground: keep the streaming interpreter as the
+control driver (items, binds, branches run concretely on the host) but
+execute each *heavy imperative do-block* as one cached `jax.jit`
+function over the environment it touches. The flagship receiver
+(`examples/wifi_rx.zir`) is exactly this shape — a few hundred
+samples of per-item control around multi-thousand-op DSP blocks (LTS
+correlation, per-symbol FFT/equalize/demap) — so the hot math runs as
+compact XLA (with the evaluator's fori_loop staging keeping graphs
+small) while header-driven dispatch stays host-level and exact.
+
+Mechanism: `hybridize(comp)` rewrites `ir.Return(closure)` nodes whose
+attached surface statements (``closure.z_stmts``, set by the
+elaborator) weigh above a threshold into `_JitDo` wrappers. The wrapper
+flattens the `ir.Env` chain to a pytree argument, rebuilds an identical
+chain of traced values inside jit, runs the SAME staged evaluator the
+fused backend traces (one semantics, shared with the oracle), and
+writes updated refs back. Each distinct env signature compiles once.
+
+Blocks containing `print`/`println`/`error` are never wrapped (side
+effects must fire per execution, and `error` must raise
+data-dependently), and any wrapper failure falls back to the direct
+closure — the interpreter semantics are always the fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ziria_tpu.core import ir
+from ziria_tpu.frontend import ast as A
+
+# a do-block is worth a jit round-trip when its (loop-weighted) op
+# count clears this; below it host dispatch overhead wins
+MIN_JIT_WEIGHT = 300
+
+# literal loop counts multiply body weight, capped so one huge loop
+# does not dominate the decision arithmetic
+_LOOP_W_CAP = 256
+
+
+def _expr_weight(e: Optional[A.Expr]) -> int:
+    if e is None or isinstance(e, (A.EInt, A.EFloat, A.EBit, A.EBool,
+                                   A.EString, A.EVar)):
+        return 1
+    if isinstance(e, A.EUn):
+        return 1 + _expr_weight(e.e)
+    if isinstance(e, A.EBin):
+        return 1 + _expr_weight(e.a) + _expr_weight(e.b)
+    if isinstance(e, A.ECond):
+        return 1 + sum(_expr_weight(x) for x in (e.c, e.a, e.b))
+    if isinstance(e, A.ECall):
+        return 2 + sum(_expr_weight(a) for a in e.args)
+    if isinstance(e, A.EIdx):
+        return 1 + _expr_weight(e.arr) + _expr_weight(e.i)
+    if isinstance(e, A.ESlice):
+        return 1 + sum(_expr_weight(x) for x in (e.arr, e.i, e.n))
+    if isinstance(e, A.EField):
+        return 1 + _expr_weight(e.e)
+    if isinstance(e, A.EArrLit):
+        return 1 + len(e.elems)
+    if isinstance(e, A.EStructLit):
+        return 1 + sum(_expr_weight(v) for _, v in e.fields)
+    return 1
+
+
+def _loop_mult(count: Optional[A.Expr]) -> int:
+    if isinstance(count, A.EInt):
+        return max(1, min(int(count.val), _LOOP_W_CAP))
+    return 8                                  # unknown count: assume some
+
+
+def _stmts_weight(stmts) -> int:
+    w = 0
+    for st in stmts:
+        if isinstance(st, A.SVar):
+            w += 1 + _expr_weight(st.init)
+        elif isinstance(st, A.SLet):
+            w += 1 + _expr_weight(st.e)
+        elif isinstance(st, A.SAssign):
+            w += _expr_weight(st.lval) + _expr_weight(st.e)
+        elif isinstance(st, A.SIf):
+            w += (_expr_weight(st.c) + _stmts_weight(st.then)
+                  + _stmts_weight(st.els))
+        elif isinstance(st, A.SFor):
+            w += _loop_mult(st.count) * (1 + _stmts_weight(st.body))
+        elif isinstance(st, A.SWhile):
+            w += 8 * (1 + _stmts_weight(st.body))
+        elif isinstance(st, (A.SReturn, A.SExpr)):
+            w += _expr_weight(st.e)
+    return w
+
+
+def _has_effects(stmts, ctx=None, _seen: Optional[set] = None) -> bool:
+    """print/println/error anywhere in the block — including inside
+    user functions it calls (recursing through ctx.funs, like the LUT
+    purity analysis) — such blocks must run un-jitted so effects fire
+    per execution, not once at trace time."""
+    hit = []
+    seen = _seen if _seen is not None else set()
+
+    def we(e):
+        if isinstance(e, A.ECall):
+            if e.name in ("print", "println", "error"):
+                hit.append(e.name)
+            elif ctx is not None and e.name in getattr(ctx, "funs", {}) \
+                    and e.name not in seen:
+                seen.add(e.name)
+                if _has_effects(ctx.funs[e.name].decl.body, ctx, seen):
+                    hit.append(e.name)
+            for a in e.args:
+                we(a)
+        elif isinstance(e, A.EUn):
+            we(e.e)
+        elif isinstance(e, A.EBin):
+            we(e.a), we(e.b)
+        elif isinstance(e, A.ECond):
+            we(e.c), we(e.a), we(e.b)
+        elif isinstance(e, A.EIdx):
+            we(e.arr), we(e.i)
+        elif isinstance(e, A.ESlice):
+            we(e.arr), we(e.i), we(e.n)
+        elif isinstance(e, A.EField):
+            we(e.e)
+        elif isinstance(e, A.EArrLit):
+            [we(x) for x in e.elems]
+        elif isinstance(e, A.EStructLit):
+            [we(v) for _, v in e.fields]
+
+    def ws(sts):
+        for st in sts:
+            if isinstance(st, A.SVar):
+                we(st.init)
+            elif isinstance(st, A.SLet):
+                we(st.e)
+            elif isinstance(st, A.SAssign):
+                we(st.lval), we(st.e)
+            elif isinstance(st, A.SIf):
+                we(st.c), ws(st.then), ws(st.els)
+            elif isinstance(st, A.SFor):
+                we(st.start), we(st.count), ws(st.body)
+            elif isinstance(st, A.SWhile):
+                we(st.c), ws(st.body)
+            elif isinstance(st, (A.SReturn, A.SExpr)):
+                we(st.e)
+
+    ws(stmts)
+    return bool(hit)
+
+
+# ------------------------------------------------------------ env pytree
+
+
+def _env_signature(env: ir.Env) -> Tuple[Tuple, List[Any]]:
+    """Flatten the env chain to (structure, values). Structure is a
+    hashable per-level tuple of (var names, ref names) outermost-first;
+    values align with it."""
+    levels = []
+    e = env
+    while e is not None:
+        levels.append(e)
+        e = e._parent
+    levels.reverse()
+    struct, vals = [], []
+    for lv in levels:
+        vnames = tuple(lv._vars.keys())
+        rnames = tuple(lv._refs.keys())
+        struct.append((vnames, rnames))
+        vals.extend(lv._vars[n] for n in vnames)
+        vals.extend(lv._refs[n] for n in rnames)
+    return tuple(struct), vals
+
+
+def _env_rebuild(struct: Tuple, vals: List[Any]) -> ir.Env:
+    env = None
+    it = iter(vals)
+    for vnames, rnames in struct:
+        env = ir.Env(env)
+        for n in vnames:
+            env.bind(n, next(it))
+        for n in rnames:
+            env.bind_ref(n, next(it))
+    return env
+
+
+def _env_refs(env: ir.Env, struct: Tuple) -> List[Any]:
+    """Ref values in structure order (outermost level first)."""
+    levels = []
+    e = env
+    while e is not None:
+        levels.append(e)
+        e = e._parent
+    levels.reverse()
+    out = []
+    for lv, (_vn, rnames) in zip(levels, struct):
+        out.extend(lv._refs[n] for n in rnames)
+    return out
+
+
+def _env_write_refs(env: ir.Env, struct: Tuple, vals: List[Any]) -> None:
+    levels = []
+    e = env
+    while e is not None:
+        levels.append(e)
+        e = e._parent
+    levels.reverse()
+    it = iter(vals)
+    for lv, (_vn, rnames) in zip(levels, struct):
+        for n in rnames:
+            lv._refs[n] = next(it)
+
+
+class _JitDo:
+    """Wraps one do-block closure: env -> jit(env-pytree) with ref
+    write-back. Falls back to the direct closure on any staging
+    failure (recorded so it does not retry every firing)."""
+
+    def __init__(self, closure):
+        self.closure = closure
+        self._fns: Dict[Tuple, Any] = {}
+        self._broken = False
+
+    def __call__(self, env: ir.Env):
+        if self._broken:
+            return self.closure(env)
+        import jax
+        try:
+            struct, vals = _env_signature(env)
+        except Exception:
+            self._broken = True
+            return self.closure(env)
+        fn = self._fns.get(struct)
+        if fn is None:
+            closure = self.closure
+
+            def raw(vals):
+                env2 = _env_rebuild(struct, list(vals))
+                r = closure(env2)
+                return r, _env_refs(env2, struct)
+
+            fn = jax.jit(raw)
+            self._fns[struct] = fn
+        try:
+            ret, refs = fn(tuple(vals))
+        except Exception:
+            # un-jittable content (non-arrayable values, dynamic takes
+            # count downstream, ...) — permanent fallback, oracle
+            # semantics preserved
+            self._broken = True
+            return self.closure(env)
+        # device -> numpy on the way out: the surrounding interpreter's
+        # per-item work runs ~50x faster on numpy than through jnp
+        # dispatch, so leaving jax Arrays in the refs would poison every
+        # downstream sample loop (measured: erased the whole win)
+        host = jax.tree_util.tree_map(np.asarray, (ret, list(refs)))
+        ret, refs = host
+        _env_write_refs(env, struct, refs)
+        return ret
+
+
+def hybridize(comp: ir.Comp, min_weight: int = MIN_JIT_WEIGHT) -> ir.Comp:
+    """Rewrite heavy do-blocks into `_JitDo` wrappers; everything else
+    is untouched. Running the result on the interpreter gives hybrid
+    execution."""
+    import dataclasses
+
+    def walk(c: ir.Comp) -> ir.Comp:
+        if isinstance(c, ir.Return) and callable(c.expr):
+            stmts = getattr(c.expr, "z_stmts", None)
+            ctx = getattr(c.expr, "z_ctx", None)
+            if stmts is not None and not _has_effects(stmts, ctx) \
+                    and _stmts_weight(stmts) >= min_weight:
+                return dataclasses.replace(c, expr=_JitDo(c.expr))
+            return c
+        return ir.map_children(c, lambda ch, _b: walk(ch))
+
+    return walk(comp)
+
+
+def run_hybrid(comp: ir.Comp, inputs, max_out: Optional[int] = None,
+               min_weight: int = MIN_JIT_WEIGHT):
+    """Interpreter driver over the hybridized program."""
+    from ziria_tpu.interp.interp import run
+    return run(hybridize(comp, min_weight), inputs, max_out=max_out)

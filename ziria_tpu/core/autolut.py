@@ -71,12 +71,15 @@ def lut_map(m: ir.Map) -> ir.Map:
     if m.lut is not None:
         # the adapter's build enforces the item cap upfront (lutinfer.
         # build_fun_table via eval_shape) and memoizes per function on
-        # the program Ctx; an oversize table means "leave un-LUT'd",
-        # matching the expression-call path's fallback
+        # the program Ctx; an oversize table — or a body that cannot be
+        # evaluated over its domain at all (unstageable + too big for
+        # the concrete fallback) — means "leave un-LUT'd", matching the
+        # expression-call path's fallback and the no-flag behavior
+        from ziria_tpu.frontend.eval import ZiriaRuntimeError
         from ziria_tpu.frontend.lutinfer import TableTooLarge
         try:
             table = m.lut.build_table()
-        except TableTooLarge:
+        except (TableTooLarge, ZiriaRuntimeError):
             return m
 
         enc = m.lut.encoder()      # closes over the spec only, not the

@@ -63,9 +63,16 @@ class _EnvRefCell:
 
 
 def _env_ref_names(env: ir.Env) -> List[str]:
+    """Ref names visible (and writable) from `env` — a ref shadowed by
+    an inner immutable bind (e.g. a comp-fun param named like an outer
+    `var`) is excluded: lookup resolves to the bind, so the block can
+    neither read nor legally write the outer ref, and exposing it as a
+    mutable cell made the staged-if merge explode on write-back."""
     out, seen = [], set()
     e = env
     while e is not None:
+        for n in e._vars:
+            seen.add(n)                      # inner binds shadow
         for n in e._refs:
             if n not in seen:
                 seen.add(n)
@@ -331,6 +338,11 @@ class Elaborator:
             r = E.exec_stmts(_stmts, scope, ctx)
             return r[1] if r is not None else None
 
+        # expose the statement AST (and the Ctx, for looking into called
+        # funs) so the hybrid executor (backend/hybrid.py) can weigh
+        # this block and decide whether to jit-compile it as a unit
+        run.z_stmts = stmts
+        run.z_ctx = ctx
         return run
 
     # -------------------------------------------------------- static_len

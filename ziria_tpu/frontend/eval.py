@@ -91,6 +91,22 @@ def is_static(v: Any) -> bool:
         v, "dtype")
 
 
+def _is_traced(*vs) -> bool:
+    """True when any value is a jax Tracer (abstract, under a trace).
+
+    Control decisions must use THIS — not ``try: bool(v)`` — to pick
+    the staged path: calling bool() on a tracer makes jax construct a
+    TracerBoolConversionError whose provenance message walks the whole
+    traced graph (observed quadratic: minutes inside a large do-block),
+    and a *concrete* jax Array coerces to bool just fine and should
+    take the eager path."""
+    try:
+        from jax.core import Tracer
+    except Exception:
+        return False
+    return any(isinstance(v, Tracer) for v in vs)
+
+
 def base_dtype(name: str):
     jnp = _jnp()
     if name == "bit":
@@ -338,12 +354,15 @@ class Scope:
         return [(n, c) for n, c in self.cells.items() if c.mutable]
 
     def mutable_cells(self) -> List[Any]:
+        return [c for _, c in self.mutable_cells_named()]
+
+    def mutable_cells_named(self) -> List[Tuple[str, Any]]:
         out, s, seen = [], self, set()
         while s is not None:
             for name, c in s.own_mutable_cells():
                 if name not in seen:
                     seen.add(name)
-                    out.append(c)
+                    out.append((name, c))
             s = s.parent
         return out
 
@@ -825,15 +844,16 @@ def _eval_call(e: A.ECall, scope: Scope, ctx: Ctx) -> Any:
             # than zip-truncating into a wrong table index
             from ziria_tpu.frontend import lutinfer
             spec = lutinfer.spec_for_fun(name, fd, ctx)
-            if spec is not None:
+            if spec is not None \
+                    and lutinfer.args_match_spec(spec, args):
                 table = ctx.lut_tables.get(name)
                 if table is None:
                     try:
                         table = lutinfer.build_fun_table(spec, fd, ctx)
-                    except lutinfer.TableTooLarge:
-                        # domain fit the bit cap but the output didn't
-                        # (e.g. int16 -> arr[256]); permanently fall
-                        # back to the direct call
+                    except (lutinfer.TableTooLarge, ZiriaRuntimeError):
+                        # output too big for the cap, or a body the
+                        # domain sweep cannot evaluate — permanently
+                        # fall back to the direct call
                         ctx.lut_specs[name] = None
                         spec = None
                     else:
@@ -945,19 +965,36 @@ def exec_stmt(st: A.Stmt, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
         c = eval_expr(st.c, scope, ctx)
         if is_static(c):
             return exec_stmts(st.then if c else st.els, scope.child(), ctx)
-        try:
-            cb = bool(c)           # eager (interpreter) path: concrete
-        except Exception:
+        if _is_traced(c):
             return _staged_if(c, st, scope, ctx)   # traced: where-merge
-        return exec_stmts(st.then if cb else st.els, scope.child(), ctx)
+        return exec_stmts(st.then if bool(c) else st.els,
+                          scope.child(), ctx)      # concrete (np or jnp)
     if isinstance(st, A.SFor):
         try:
             start = ctx.static_eval(st.start, scope)
             count = ctx.static_eval(st.count, scope)
         except NotStatic:
+            if _tracing() and not _has_return(st.body):
+                # traced trip count inside a jit trace (e.g. a bound
+                # computed from traced data): lax.fori_loop accepts
+                # traced bounds, so stage instead of refusing — the C
+                # backend of the reference compiles these trivially
+                s_v = eval_expr(st.start, scope, ctx)
+                c_v = eval_expr(st.count, scope, ctx)
+                if np.size(s_v) == 1 and np.size(c_v) == 1:
+                    return _staged_for(s_v, c_v, st, scope, ctx)
             raise _rt_err(st.loc, "for-loop bounds must be compile-time "
                                   "static (use while for dynamic trip "
                                   "counts)")
+        if int(count) >= FORI_MIN_COUNT and _tracing() \
+                and not _has_return(st.body) \
+                and _reads_traced(st.body, scope):
+            # large loop over traced data inside a jit trace: stage as
+            # ONE lax.fori_loop instead of unrolling count copies of
+            # the body into the graph (compile-time blow-up on e.g. a
+            # 258x64 correlation); loops over concrete values keep the
+            # Python path so they constant-fold at trace time
+            return _staged_for(int(start), int(count), st, scope, ctx)
         for i in range(int(start), int(start) + int(count)):
             s = scope.child()
             s.declare(st.var, i, None, mutable=False)
@@ -974,7 +1011,7 @@ def exec_stmt(st: A.Stmt, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
                 raise _rt_err(st.loc,
                               f"while condition must be a scalar "
                               f"boolean, got shape {np.shape(c)}")
-            if not _np_ok(c):
+            if _is_traced(c):
                 # traced condition (possibly only from this iteration
                 # on): stage the rest of the loop as lax.while_loop
                 return _staged_while(st, scope, ctx)
@@ -989,6 +1026,196 @@ def exec_stmt(st: A.Stmt, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
         eval_expr(st.e, scope, ctx)
         return None
     raise _rt_err(st.loc, f"unknown statement {type(st).__name__}")
+
+
+# statement for-loops at or above this trip count, reading traced data
+# inside a jit trace, stage as lax.fori_loop; below it they unroll
+# (small bodies fuse better as straight-line code)
+FORI_MIN_COUNT = 24
+
+
+def _tracing() -> bool:
+    """True when called under a jax trace (jit/vmap/scan staging)."""
+    try:
+        from jax._src.core import trace_state_clean
+    except ImportError:       # public alias in some jax versions
+        try:
+            from jax.core import trace_state_clean  # type: ignore
+        except ImportError:
+            return False
+    return not trace_state_clean()
+
+
+def _expr_reads(e: Optional[A.Expr], acc: set) -> None:
+    if e is None or isinstance(e, (A.EInt, A.EFloat, A.EBit, A.EBool,
+                                   A.EString)):
+        return
+    if isinstance(e, A.EVar):
+        acc.add(e.name)
+    elif isinstance(e, A.EUn):
+        _expr_reads(e.e, acc)
+    elif isinstance(e, A.EBin):
+        _expr_reads(e.a, acc)
+        _expr_reads(e.b, acc)
+    elif isinstance(e, A.ECond):
+        for x in (e.c, e.a, e.b):
+            _expr_reads(x, acc)
+    elif isinstance(e, A.ECall):
+        for a in e.args:
+            _expr_reads(a, acc)
+    elif isinstance(e, A.EIdx):
+        _expr_reads(e.arr, acc)
+        _expr_reads(e.i, acc)
+    elif isinstance(e, A.ESlice):
+        for x in (e.arr, e.i, e.n):
+            _expr_reads(x, acc)
+    elif isinstance(e, A.EField):
+        _expr_reads(e.e, acc)
+    elif isinstance(e, A.EArrLit):
+        for x in e.elems:
+            _expr_reads(x, acc)
+    elif isinstance(e, A.EStructLit):
+        for _, x in e.fields:
+            _expr_reads(x, acc)
+
+
+def _stmt_reads(stmts, acc: set) -> None:
+    for st in stmts:
+        if isinstance(st, A.SVar):
+            _expr_reads(st.init, acc)
+        elif isinstance(st, A.SLet):
+            _expr_reads(st.e, acc)
+        elif isinstance(st, A.SAssign):
+            _expr_reads(st.lval, acc)
+            _expr_reads(st.e, acc)
+        elif isinstance(st, A.SIf):
+            _expr_reads(st.c, acc)
+            _stmt_reads(st.then, acc)
+            _stmt_reads(st.els, acc)
+        elif isinstance(st, A.SFor):
+            _expr_reads(st.start, acc)
+            _expr_reads(st.count, acc)
+            _stmt_reads(st.body, acc)
+        elif isinstance(st, A.SWhile):
+            _expr_reads(st.c, acc)
+            _stmt_reads(st.body, acc)
+        elif isinstance(st, (A.SReturn, A.SExpr)):
+            _expr_reads(st.e, acc)
+
+
+def _reads_traced(stmts, scope: Scope) -> bool:
+    """Does this body read any name currently bound to a traced value?
+    (Over-approximates: locally-declared names are included but resolve
+    to outer cells or nothing — both harmless.)"""
+    names: set = set()
+    _stmt_reads(stmts, names)
+    for name in names:
+        c = scope.find(name)
+        if c is not None and _is_traced(c.value):
+            return True
+    return False
+
+
+def _has_return(stmts) -> bool:
+    for st in stmts:
+        if isinstance(st, A.SReturn):
+            return True
+        if isinstance(st, A.SIf) and (_has_return(st.then)
+                                      or _has_return(st.els)):
+            return True
+        if isinstance(st, (A.SFor, A.SWhile)) and _has_return(st.body):
+            return True
+    return False
+
+
+def _stmt_writes(stmts, acc: set) -> None:
+    """Names assigned (lval roots) or var-declared in this body —
+    the loop-carried set for staged for/while. Over-approximates with
+    body-local declarations; those resolve to shadowing outer cells or
+    nothing, both harmless."""
+    for st in stmts:
+        if isinstance(st, (A.SVar, A.SLet)):
+            acc.add(st.name)
+        elif isinstance(st, A.SAssign):
+            e = st.lval
+            while isinstance(e, (A.EIdx, A.ESlice, A.EField)):
+                e = e.e if isinstance(e, A.EField) else e.arr
+            if isinstance(e, A.EVar):
+                acc.add(e.name)
+        elif isinstance(st, A.SIf):
+            _stmt_writes(st.then, acc)
+            _stmt_writes(st.els, acc)
+        elif isinstance(st, (A.SFor, A.SWhile)):
+            _stmt_writes(st.body, acc)
+
+
+def _written_cells(stmts, scope: Scope) -> List[Any]:
+    """Only the mutable cells this body can assign: the minimal carry
+    for lax.fori_loop/while_loop staging. Threading every cell in scope
+    (the _staged_if approach) makes carries ~25 leaves deep in real
+    programs and was measured to blow both compile time and the traced
+    graph size."""
+    writes: set = set()
+    _stmt_writes(stmts, writes)
+    return [c for n, c in scope.mutable_cells_named() if n in writes]
+
+
+def _staged_for(start, count, st: A.SFor, scope: Scope,
+                ctx: Ctx):
+    """Stage one statement for-loop as `lax.fori_loop` carrying the
+    cells the body writes (same discipline as _staged_while: stable
+    tree structure, entry-pinned leaf dtypes). The loop variable is the
+    traced fori index; dynamic-index reads/writes lower to gathers and
+    `.at[].set` via the normal expression paths. `start`/`count` may be
+    ints or traced scalars (fori_loop takes both)."""
+    import jax
+    from jax import lax
+    jnp = _jnp()
+    cells = _written_cells(st.body, scope)
+
+    try:
+        flat0, td0 = jax.tree_util.tree_flatten(
+            [c.value for c in cells])
+        flat0 = [jnp.asarray(x) for x in flat0]
+    except Exception:
+        raise _rt_err(
+            st.loc, "for-loop over traced data: a variable in scope "
+                    "holds a non-stageable value; run this program on "
+                    "the interpreter backend") from None
+    dts = [x.dtype for x in flat0]
+
+    def put(flat):
+        vals = jax.tree_util.tree_unflatten(td0, list(flat))
+        for c, v in zip(cells, vals):
+            c.value = v
+
+    def body_fn(i, flat):
+        put(flat)
+        s = scope.child()
+        s.declare(st.var, i, None, mutable=False)
+        r = exec_stmts(st.body, s, ctx)
+        if r is not None:          # unreachable: _has_return pre-check
+            raise _rt_err(st.loc, "return inside a staged for-loop")
+        leaves, td = jax.tree_util.tree_flatten(
+            [c.value for c in cells])
+        if td != td0:
+            raise _rt_err(
+                st.loc, "staged for-loop changes a variable's "
+                        "structure (struct fields) across iterations")
+        return tuple(jnp.asarray(x).astype(dt)
+                     for x, dt in zip(leaves, dts))
+
+    try:
+        out = lax.fori_loop(start, start + count, body_fn, tuple(flat0))
+    except ZiriaRuntimeError:
+        raise
+    except TypeError as e:
+        raise _rt_err(
+            st.loc, f"staged for-loop has a loop-varying state shape "
+                    f"({e}); every assigned variable must keep its "
+                    f"shape") from None
+    put(out)
+    return None
 
 
 def _staged_while(st: A.SWhile, scope: Scope, ctx: Ctx):
@@ -1006,7 +1233,14 @@ def _staged_while(st: A.SWhile, scope: Scope, ctx: Ctx):
     import jax
     from jax import lax
     jnp = _jnp()
-    cells = scope.mutable_cells()
+    # carry = cells the body writes, plus anything the CONDITION reads
+    # that is mutable (it must be in the carry to drive the loop)
+    cond_reads: set = set()
+    _expr_reads(st.c, cond_reads)
+    writes: set = set()
+    _stmt_writes(st.body, writes)
+    names = writes | cond_reads
+    cells = [c for n, c in scope.mutable_cells_named() if n in names]
 
     try:
         flat0, td0 = jax.tree_util.tree_flatten(

@@ -208,15 +208,25 @@ def _viterbi_soft(llrs, npairs, nbits):
 
     from ziria_tpu.ops.viterbi import np_viterbi_decode
 
-    if any(isinstance(a, Tracer) for a in (llrs, npairs, nbits)):
+    if isinstance(npairs, Tracer) or isinstance(nbits, Tracer):
         raise TypeError(
-            "ext fun viterbi_soft needs concrete (data-dependent) "
-            "lengths and runs on the interpreter backend only; the jit "
-            "backend's static-shape decode is ops/viterbi.viterbi_decode"
-            " / ops/viterbi_pallas.viterbi_decode_batch")
-    arr = np.asarray(llrs, np.float32)
+            "ext fun viterbi_soft needs static lengths (npairs/nbits "
+            "must not depend on traced data); the jit backend's "
+            "static-shape decode is ops/viterbi.viterbi_decode / "
+            "ops/viterbi_pallas.viterbi_decode_batch")
     npairs = int(np.asarray(npairs))
     nbits = int(np.asarray(nbits))
+    if isinstance(llrs, Tracer):
+        # staged call (jit / hybrid do-block): static lengths make the
+        # shapes static, so decode with the lax.scan ACS kernel
+        import jax.numpy as jnp
+
+        from ziria_tpu.ops.viterbi import viterbi_decode
+        arr = jnp.asarray(llrs, jnp.float32)
+        bits = viterbi_decode(arr[: 2 * npairs], n_bits=nbits)
+        out = jnp.zeros(arr.shape[0] // 2, jnp.uint8)
+        return out.at[:nbits].set(bits.astype(jnp.uint8))
+    arr = np.asarray(llrs, np.float32)
     bits = np_viterbi_decode(arr[: 2 * npairs], n_bits=nbits)
     out = np.zeros(arr.shape[0] // 2, np.uint8)
     out[:nbits] = bits

@@ -250,6 +250,24 @@ def spec_for_fun(name: str, fd, ctx) -> Optional[LutSpec]:
 # ---------------------------------------------------------------- pack/unpack
 
 
+def args_match_spec(spec: LutSpec, args: List[Any]) -> bool:
+    """Shapes must agree with the spec before packing: a mismatched
+    array length would silently broadcast into a garbage index, where
+    the direct call raises a clear length error — so mismatches fall
+    back to the direct path."""
+    if len(args) != len(spec.args):
+        return False
+    for a, v in zip(spec.args, args):
+        if a.kind in ("bit", "bool", "int8", "int16"):
+            if np.ndim(v) != 0:
+                return False
+        else:
+            shp = np.shape(v)
+            if len(shp) != 1 or shp[0] != a.n:
+                return False
+    return True
+
+
 def encode_args(spec: LutSpec, args: List[Any]) -> Any:
     """Pack runtime argument values into the LUT index (staged: works on
     traced jnp values; first arg occupies the high bits)."""
@@ -257,8 +275,11 @@ def encode_args(spec: LutSpec, args: List[Any]) -> Any:
 
     idx = None
     for a, v in zip(spec.args, args):
-        if a.kind in ("bit", "bool"):
+        if a.kind == "bit":
             enc = jnp.asarray(v, jnp.int32) & 1
+        elif a.kind == "bool":
+            # nonzero-is-True, matching cast_value's bool semantics
+            enc = (jnp.asarray(v) != 0).astype(jnp.int32)
         elif a.kind == "int8":
             enc = jnp.asarray(v, jnp.int32) & 0xFF
         elif a.kind == "int16":

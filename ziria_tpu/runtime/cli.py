@@ -146,7 +146,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="item type (default: from the program's write[t], "
                         "else int32)")
 
-    p.add_argument("--backend", default="jit", choices=["interp", "jit"])
+    p.add_argument("--backend", default="jit",
+                   choices=["interp", "jit", "hybrid"])
     p.add_argument("--width", type=int, default=None,
                    help="vectorization width (default: planner)")
     p.add_argument("--fold", action="store_true", default=True)
@@ -329,10 +330,16 @@ def _run_backend(comp, xs, args, t0):
     if args.profile:
         ys = _run_profiled(comp, xs, args)
         return ys, time.perf_counter() - t0
-    if args.backend == "interp":
+    if args.backend in ("interp", "hybrid"):
         if args.state_in or args.state_out:
             raise SystemExit("--state-in/--state-out need --backend=jit "
                              "(stream state is the jit carry pytree)")
+        if args.backend == "hybrid":
+            # interpreter-driven control, jit-compiled heavy do-blocks
+            # (backend/hybrid.py) — for dynamic-control programs like
+            # the flagship receiver that the fused jit path refuses
+            from ziria_tpu.backend.hybrid import hybridize
+            comp = hybridize(comp)
         from ziria_tpu.interp.interp import run
         res = run(comp, list(xs))
         ys = np.asarray(res.out_array())
