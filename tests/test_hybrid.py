@@ -93,6 +93,30 @@ def test_jitdo_writes_back_numpy():
     del hyb
 
 
+def test_cli_jit_falls_back_to_hybrid(tmp_path, capsys):
+    # --backend=jit on a dynamic-control program must not error: it
+    # falls back to the hybrid executor with a stderr note
+    from ziria_tpu.runtime.buffers import (StreamSpec, read_stream,
+                                           write_stream)
+    from ziria_tpu.runtime.cli import main as cli_main
+    psdu, xi = _capture(6, 30, seed=13)
+    inf, outf = tmp_path / "in.bin", tmp_path / "out.bin"
+    write_stream(StreamSpec(ty="complex16", path=str(inf), mode="bin"), xi)
+    rc = cli_main([
+        f"--src={SRC}",
+        "--input=file", f"--input-file-name={inf}",
+        "--input-file-mode=bin",
+        "--output=file", f"--output-file-name={outf}",
+        "--output-file-mode=bin", "--backend=jit",
+    ])
+    assert rc == 0
+    assert "falling back to --backend=hybrid" in capsys.readouterr().err
+    got = read_stream(StreamSpec(ty="bit", path=str(outf), mode="bin"))
+    from ziria_tpu.utils.bits import bytes_to_bits
+    np.testing.assert_array_equal(got[: 8 * 30],
+                                  np.asarray(bytes_to_bits(psdu)))
+
+
 def test_env_ref_shadowing_excluded():
     from ziria_tpu.frontend.elab import _env_ref_names
     env = ir.Env()

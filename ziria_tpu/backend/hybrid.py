@@ -47,28 +47,10 @@ _LOOP_W_CAP = 256
 
 
 def _expr_weight(e: Optional[A.Expr]) -> int:
-    if e is None or isinstance(e, (A.EInt, A.EFloat, A.EBit, A.EBool,
-                                   A.EString, A.EVar)):
-        return 1
-    if isinstance(e, A.EUn):
-        return 1 + _expr_weight(e.e)
-    if isinstance(e, A.EBin):
-        return 1 + _expr_weight(e.a) + _expr_weight(e.b)
-    if isinstance(e, A.ECond):
-        return 1 + sum(_expr_weight(x) for x in (e.c, e.a, e.b))
-    if isinstance(e, A.ECall):
-        return 2 + sum(_expr_weight(a) for a in e.args)
-    if isinstance(e, A.EIdx):
-        return 1 + _expr_weight(e.arr) + _expr_weight(e.i)
-    if isinstance(e, A.ESlice):
-        return 1 + sum(_expr_weight(x) for x in (e.arr, e.i, e.n))
-    if isinstance(e, A.EField):
-        return 1 + _expr_weight(e.e)
-    if isinstance(e, A.EArrLit):
-        return 1 + len(e.elems)
-    if isinstance(e, A.EStructLit):
-        return 1 + sum(_expr_weight(v) for _, v in e.fields)
-    return 1
+    if e is None:
+        return 0
+    base = 2 if isinstance(e, A.ECall) else 1
+    return base + sum(_expr_weight(k) for k in A.child_exprs(e))
 
 
 def _loop_mult(count: Optional[A.Expr]) -> int:
@@ -80,21 +62,13 @@ def _loop_mult(count: Optional[A.Expr]) -> int:
 def _stmts_weight(stmts) -> int:
     w = 0
     for st in stmts:
-        if isinstance(st, A.SVar):
-            w += 1 + _expr_weight(st.init)
-        elif isinstance(st, A.SLet):
-            w += 1 + _expr_weight(st.e)
-        elif isinstance(st, A.SAssign):
-            w += _expr_weight(st.lval) + _expr_weight(st.e)
-        elif isinstance(st, A.SIf):
-            w += (_expr_weight(st.c) + _stmts_weight(st.then)
-                  + _stmts_weight(st.els))
-        elif isinstance(st, A.SFor):
+        w += sum(_expr_weight(e) for e in A.stmt_exprs(st)) + 1
+        if isinstance(st, A.SFor):
             w += _loop_mult(st.count) * (1 + _stmts_weight(st.body))
         elif isinstance(st, A.SWhile):
             w += 8 * (1 + _stmts_weight(st.body))
-        elif isinstance(st, (A.SReturn, A.SExpr)):
-            w += _expr_weight(st.e)
+        elif isinstance(st, A.SIf):
+            w += _stmts_weight(st.then) + _stmts_weight(st.els)
     return w
 
 
@@ -103,56 +77,18 @@ def _has_effects(stmts, ctx=None, _seen: Optional[set] = None) -> bool:
     user functions it calls (recursing through ctx.funs, like the LUT
     purity analysis) — such blocks must run un-jitted so effects fire
     per execution, not once at trace time."""
-    hit = []
     seen = _seen if _seen is not None else set()
-
-    def we(e):
-        if isinstance(e, A.ECall):
-            if e.name in ("print", "println", "error"):
-                hit.append(e.name)
-            elif ctx is not None and e.name in getattr(ctx, "funs", {}) \
-                    and e.name not in seen:
-                seen.add(e.name)
-                if _has_effects(ctx.funs[e.name].decl.body, ctx, seen):
-                    hit.append(e.name)
-            for a in e.args:
-                we(a)
-        elif isinstance(e, A.EUn):
-            we(e.e)
-        elif isinstance(e, A.EBin):
-            we(e.a), we(e.b)
-        elif isinstance(e, A.ECond):
-            we(e.c), we(e.a), we(e.b)
-        elif isinstance(e, A.EIdx):
-            we(e.arr), we(e.i)
-        elif isinstance(e, A.ESlice):
-            we(e.arr), we(e.i), we(e.n)
-        elif isinstance(e, A.EField):
-            we(e.e)
-        elif isinstance(e, A.EArrLit):
-            [we(x) for x in e.elems]
-        elif isinstance(e, A.EStructLit):
-            [we(v) for _, v in e.fields]
-
-    def ws(sts):
-        for st in sts:
-            if isinstance(st, A.SVar):
-                we(st.init)
-            elif isinstance(st, A.SLet):
-                we(st.e)
-            elif isinstance(st, A.SAssign):
-                we(st.lval), we(st.e)
-            elif isinstance(st, A.SIf):
-                we(st.c), ws(st.then), ws(st.els)
-            elif isinstance(st, A.SFor):
-                we(st.start), we(st.count), ws(st.body)
-            elif isinstance(st, A.SWhile):
-                we(st.c), ws(st.body)
-            elif isinstance(st, (A.SReturn, A.SExpr)):
-                we(st.e)
-
-    ws(stmts)
-    return bool(hit)
+    for e in A.iter_stmt_exprs(stmts):
+        if not isinstance(e, A.ECall):
+            continue
+        if e.name in ("print", "println", "error"):
+            return True
+        if ctx is not None and e.name in getattr(ctx, "funs", {}) \
+                and e.name not in seen:
+            seen.add(e.name)
+            if _has_effects(ctx.funs[e.name].decl.body, ctx, seen):
+                return True
+    return False
 
 
 # ------------------------------------------------------------ env pytree
@@ -265,18 +201,31 @@ class _JitDo:
         return ret
 
 
-def hybridize(comp: ir.Comp, min_weight: int = MIN_JIT_WEIGHT) -> ir.Comp:
+def hybridize(comp: ir.Comp, min_weight: int = MIN_JIT_WEIGHT,
+              dump=None) -> ir.Comp:
     """Rewrite heavy do-blocks into `_JitDo` wrappers; everything else
     is untouched. Running the result on the interpreter gives hybrid
-    execution."""
+    execution. `dump`, if given, receives one line per do-block with
+    its decision (the --ddump-hybrid flag)."""
     import dataclasses
 
     def walk(c: ir.Comp) -> ir.Comp:
         if isinstance(c, ir.Return) and callable(c.expr):
             stmts = getattr(c.expr, "z_stmts", None)
+            if stmts is None:
+                return c
             ctx = getattr(c.expr, "z_ctx", None)
-            if stmts is not None and not _has_effects(stmts, ctx) \
-                    and _stmts_weight(stmts) >= min_weight:
+            w = _stmts_weight(stmts)
+            fx = _has_effects(stmts, ctx)
+            jit_it = not fx and w >= min_weight
+            if dump is not None:
+                loc = getattr(stmts[0], "loc", ("?", "?")) if stmts \
+                    else ("?", "?")
+                why = ("jit" if jit_it else
+                       "effects" if fx else f"below {min_weight}")
+                dump(f"  do-block @{loc[0]}:{loc[1]} weight={w} "
+                     f"-> {why}")
+            if jit_it:
                 return dataclasses.replace(c, expr=_JitDo(c.expr))
             return c
         return ir.map_children(c, lambda ch, _b: walk(ch))

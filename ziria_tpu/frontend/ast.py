@@ -495,3 +495,106 @@ class DStruct(Decl):
 @dataclass(frozen=True)
 class Program:
     decls: Tuple[Decl, ...] = ()
+
+
+# --------------------------------------------------------------------------
+# Canonical traversal helpers
+#
+# Every analysis that walks the surface AST (purity/bit-width inference
+# in lutinfer, read/write sets for staged loops in eval, weight/effect
+# scans in backend/hybrid) iterates children through THESE generators,
+# so the node inventory lives in exactly one place. They raise on an
+# unknown node class — a future statement/expression kind breaks the
+# walkers loudly instead of being silently skipped (which would, e.g.,
+# let an effectful block be jit-wrapped or drop a written cell from a
+# staged-loop carry).
+# --------------------------------------------------------------------------
+
+_LEAF_EXPRS = (EInt, EFloat, EBit, EBool, EString, EVar)
+
+
+def child_exprs(e: Optional[Expr]):
+    """Direct sub-expressions of `e` (none for leaves/None)."""
+    if e is None or isinstance(e, _LEAF_EXPRS):
+        return
+    if isinstance(e, EUn):
+        kids = (e.e,)
+    elif isinstance(e, EBin):
+        kids = (e.a, e.b)
+    elif isinstance(e, ECond):
+        kids = (e.c, e.a, e.b)
+    elif isinstance(e, ECall):
+        kids = e.args
+    elif isinstance(e, EIdx):
+        kids = (e.arr, e.i)
+    elif isinstance(e, ESlice):
+        kids = (e.arr, e.i, e.n)
+    elif isinstance(e, EField):
+        kids = (e.e,)
+    elif isinstance(e, EArrLit):
+        kids = e.elems
+    elif isinstance(e, EStructLit):
+        kids = tuple(v for _, v in e.fields)
+    else:
+        raise TypeError(f"child_exprs: unknown expression node "
+                        f"{type(e).__name__}")
+    for k in kids:
+        if k is not None:
+            yield k
+
+
+def iter_exprs(e: Optional[Expr]):
+    """`e` and every expression beneath it, depth-first."""
+    if e is None:
+        return
+    yield e
+    for k in child_exprs(e):
+        yield from iter_exprs(k)
+
+
+def stmt_exprs(st: Stmt):
+    """Expressions appearing directly in `st` (not in nested stmts)."""
+    if isinstance(st, SVar):
+        kids = (st.init,)
+    elif isinstance(st, SLet):
+        kids = (st.e,)
+    elif isinstance(st, SAssign):
+        kids = (st.lval, st.e)
+    elif isinstance(st, SIf):
+        kids = (st.c,)
+    elif isinstance(st, SFor):
+        kids = (st.start, st.count)
+    elif isinstance(st, SWhile):
+        kids = (st.c,)
+    elif isinstance(st, (SReturn, SExpr)):
+        kids = (st.e,)
+    else:
+        raise TypeError(f"stmt_exprs: unknown statement node "
+                        f"{type(st).__name__}")
+    for k in kids:
+        if k is not None:
+            yield k
+
+
+def child_stmt_blocks(st: Stmt):
+    """Nested statement tuples of `st`."""
+    if isinstance(st, SIf):
+        yield st.then
+        yield st.els
+    elif isinstance(st, (SFor, SWhile)):
+        yield st.body
+
+
+def iter_stmts(stmts):
+    """Every statement in the body, depth-first (including nested)."""
+    for st in stmts:
+        yield st
+        for blk in child_stmt_blocks(st):
+            yield from iter_stmts(blk)
+
+
+def iter_stmt_exprs(stmts):
+    """Every expression anywhere in the body, depth-first."""
+    for st in iter_stmts(stmts):
+        for e in stmt_exprs(st):
+            yield from iter_exprs(e)

@@ -1047,60 +1047,15 @@ def _tracing() -> bool:
 
 
 def _expr_reads(e: Optional[A.Expr], acc: set) -> None:
-    if e is None or isinstance(e, (A.EInt, A.EFloat, A.EBit, A.EBool,
-                                   A.EString)):
-        return
-    if isinstance(e, A.EVar):
-        acc.add(e.name)
-    elif isinstance(e, A.EUn):
-        _expr_reads(e.e, acc)
-    elif isinstance(e, A.EBin):
-        _expr_reads(e.a, acc)
-        _expr_reads(e.b, acc)
-    elif isinstance(e, A.ECond):
-        for x in (e.c, e.a, e.b):
-            _expr_reads(x, acc)
-    elif isinstance(e, A.ECall):
-        for a in e.args:
-            _expr_reads(a, acc)
-    elif isinstance(e, A.EIdx):
-        _expr_reads(e.arr, acc)
-        _expr_reads(e.i, acc)
-    elif isinstance(e, A.ESlice):
-        for x in (e.arr, e.i, e.n):
-            _expr_reads(x, acc)
-    elif isinstance(e, A.EField):
-        _expr_reads(e.e, acc)
-    elif isinstance(e, A.EArrLit):
-        for x in e.elems:
-            _expr_reads(x, acc)
-    elif isinstance(e, A.EStructLit):
-        for _, x in e.fields:
-            _expr_reads(x, acc)
+    for x in A.iter_exprs(e):
+        if isinstance(x, A.EVar):
+            acc.add(x.name)
 
 
 def _stmt_reads(stmts, acc: set) -> None:
-    for st in stmts:
-        if isinstance(st, A.SVar):
-            _expr_reads(st.init, acc)
-        elif isinstance(st, A.SLet):
-            _expr_reads(st.e, acc)
-        elif isinstance(st, A.SAssign):
-            _expr_reads(st.lval, acc)
-            _expr_reads(st.e, acc)
-        elif isinstance(st, A.SIf):
-            _expr_reads(st.c, acc)
-            _stmt_reads(st.then, acc)
-            _stmt_reads(st.els, acc)
-        elif isinstance(st, A.SFor):
-            _expr_reads(st.start, acc)
-            _expr_reads(st.count, acc)
-            _stmt_reads(st.body, acc)
-        elif isinstance(st, A.SWhile):
-            _expr_reads(st.c, acc)
-            _stmt_reads(st.body, acc)
-        elif isinstance(st, (A.SReturn, A.SExpr)):
-            _expr_reads(st.e, acc)
+    for x in A.iter_stmt_exprs(stmts):
+        if isinstance(x, A.EVar):
+            acc.add(x.name)
 
 
 def _reads_traced(stmts, scope: Scope) -> bool:
@@ -1117,15 +1072,7 @@ def _reads_traced(stmts, scope: Scope) -> bool:
 
 
 def _has_return(stmts) -> bool:
-    for st in stmts:
-        if isinstance(st, A.SReturn):
-            return True
-        if isinstance(st, A.SIf) and (_has_return(st.then)
-                                      or _has_return(st.els)):
-            return True
-        if isinstance(st, (A.SFor, A.SWhile)) and _has_return(st.body):
-            return True
-    return False
+    return any(isinstance(st, A.SReturn) for st in A.iter_stmts(stmts))
 
 
 def _stmt_writes(stmts, acc: set) -> None:
@@ -1133,7 +1080,7 @@ def _stmt_writes(stmts, acc: set) -> None:
     the loop-carried set for staged for/while. Over-approximates with
     body-local declarations; those resolve to shadowing outer cells or
     nothing, both harmless."""
-    for st in stmts:
+    for st in A.iter_stmts(stmts):
         if isinstance(st, (A.SVar, A.SLet)):
             acc.add(st.name)
         elif isinstance(st, A.SAssign):
@@ -1142,11 +1089,6 @@ def _stmt_writes(stmts, acc: set) -> None:
                 e = e.e if isinstance(e, A.EField) else e.arr
             if isinstance(e, A.EVar):
                 acc.add(e.name)
-        elif isinstance(st, A.SIf):
-            _stmt_writes(st.then, acc)
-            _stmt_writes(st.els, acc)
-        elif isinstance(st, (A.SFor, A.SWhile)):
-            _stmt_writes(st.body, acc)
 
 
 def _written_cells(stmts, scope: Scope) -> List[Any]:

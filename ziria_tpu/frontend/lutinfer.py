@@ -122,8 +122,7 @@ def _lval_root(e: A.Expr) -> Optional[str]:
 
 def _pure_expr(e: Optional[A.Expr], locals_: Set[str], fd, ctx,
                seen: Set[str]) -> bool:
-    if e is None or isinstance(e, (A.EInt, A.EFloat, A.EBit, A.EBool,
-                                   A.EString)):
+    if e is None:
         return True
     if isinstance(e, A.EVar):
         if e.name in locals_:
@@ -132,14 +131,6 @@ def _pure_expr(e: Optional[A.Expr], locals_: Set[str], fd, ctx,
         # immutable closure bindings (global `let` constants) are baked
         # into the table; anything mutable would make the table stale
         return cell is not None and not cell.mutable
-    if isinstance(e, A.EUn):
-        return _pure_expr(e.e, locals_, fd, ctx, seen)
-    if isinstance(e, A.EBin):
-        return (_pure_expr(e.a, locals_, fd, ctx, seen)
-                and _pure_expr(e.b, locals_, fd, ctx, seen))
-    if isinstance(e, A.ECond):
-        return all(_pure_expr(x, locals_, fd, ctx, seen)
-                   for x in (e.c, e.a, e.b))
     if isinstance(e, A.ECall):
         from ziria_tpu.frontend.eval import _BASE_TYPE_NAMES
         if not all(_pure_expr(a, locals_, fd, ctx, seen) for a in e.args):
@@ -153,20 +144,10 @@ def _pure_expr(e: Optional[A.Expr], locals_: Set[str], fd, ctx,
             return _pure_fun_body(e.name, sub, ctx, seen)
         # registered externals: a closed pure-DSP-math registry
         return e.name in ctx.exts
-    if isinstance(e, A.EIdx):
-        return (_pure_expr(e.arr, locals_, fd, ctx, seen)
-                and _pure_expr(e.i, locals_, fd, ctx, seen))
-    if isinstance(e, A.ESlice):
-        return all(_pure_expr(x, locals_, fd, ctx, seen)
-                   for x in (e.arr, e.i, e.n))
-    if isinstance(e, A.EField):
-        return _pure_expr(e.e, locals_, fd, ctx, seen)
-    if isinstance(e, A.EArrLit):
-        return all(_pure_expr(x, locals_, fd, ctx, seen) for x in e.elems)
-    if isinstance(e, A.EStructLit):
-        return all(_pure_expr(v, locals_, fd, ctx, seen)
-                   for _, v in e.fields)
-    return False
+    # all other node kinds are pure iff their children are
+    # (A.child_exprs raises on unknown nodes — fail closed)
+    return all(_pure_expr(k, locals_, fd, ctx, seen)
+               for k in A.child_exprs(e))
 
 
 def _pure_stmts(stmts, locals_: Set[str], fd, ctx, seen: Set[str]) -> bool:

@@ -163,6 +163,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dump the IR after folding")
     p.add_argument("--ddump-vect", action="store_true",
                    help="dump the vectorizer's scored candidate table")
+    p.add_argument("--ddump-hybrid", action="store_true",
+                   help="dump the hybrid executor's per-do-block "
+                        "decisions (weight, jit/effects/below-threshold)")
     p.add_argument("--stats", action="store_true",
                    help="print the fused plan: per-stage firing counts, "
                         "rates, width (jit backend)")
@@ -288,6 +291,10 @@ def main(argv=None) -> int:
     if args.ddump_vect:
         from ziria_tpu.core.vectorize import vectorize
         print(vectorize(comp).dump(), file=sys.stderr)
+    if args.ddump_hybrid:
+        from ziria_tpu.backend.hybrid import hybridize
+        print("hybrid plan:", file=sys.stderr)
+        hybridize(comp, dump=lambda s: print(s, file=sys.stderr))
 
     in_spec = StreamSpec(kind=args.input, ty=in_ty,
                          path=args.input_file_name,
@@ -345,6 +352,7 @@ def _run_backend(comp, xs, args, t0):
         ys = np.asarray(res.out_array())
     else:
         from ziria_tpu.backend.execute import lower, run_jit_carry
+        from ziria_tpu.backend.lower import LowerError
         carry = None
         if args.state_in:
             from ziria_tpu.runtime.state import (load_state,
@@ -354,8 +362,27 @@ def _run_backend(comp, xs, args, t0):
                                .init_carry,
                                fingerprint=program_fingerprint(comp))
         stats: Optional[dict] = {} if args.stats else None
-        ys, carry = run_jit_carry(comp, xs, carry=carry, width=args.width,
-                                  stats_out=stats)
+        try:
+            ys, carry = run_jit_carry(comp, xs, carry=carry,
+                                      width=args.width, stats_out=stats)
+        except LowerError as e:
+            # dynamic-control programs can't fuse; instead of refusing
+            # (the reference's compiler compiles everything), fall back
+            # to the hybrid executor — same results, control on the
+            # host, heavy blocks still jit-compiled. (LowerError is
+            # raised before any execution, so nothing ran twice.)
+            if args.state_in or args.state_out:
+                raise SystemExit(
+                    f"--state-in/--state-out need a fusable pipeline "
+                    f"({e})")
+            print(f"note: program has dynamic control "
+                  f"({e}); falling back to --backend=hybrid",
+                  file=sys.stderr)
+            from ziria_tpu.backend.hybrid import hybridize
+            from ziria_tpu.interp.interp import run
+            res = run(hybridize(comp), list(xs))
+            return (np.asarray(res.out_array()),
+                    time.perf_counter() - t0)
         ys = np.asarray(ys)
         if args.state_out:
             from ziria_tpu.runtime.state import (program_fingerprint,
