@@ -141,6 +141,34 @@ def test_viterbi_soft_traced_with_static_lengths():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_weight_heuristic_pinned():
+    # the wrap/no-wrap decision is a performance contract: pin the
+    # weights of two canonical bodies so heuristic drift (e.g. during
+    # walker refactors) is a conscious, test-visible choice
+    from ziria_tpu.frontend.parser import parse_program
+    loopy = parse_program("""
+      fun f1(x: int32) : int32 {
+        var acc : int32 := 0;
+        for k in [0, 64] {
+          var s : int32 := 0;
+          for i in [0, 32] { s := s + x * (k + i) };
+          acc := acc + s
+        }
+        return acc
+      }
+    """, "<w>").decls[0]
+    flat = parse_program("""
+      fun f2(x: int32) : int32 {
+        var a : int32 := x + 1;
+        if a > 0 then { a := a * 2 } else { a := a - 2 };
+        return a
+      }
+    """, "<w>").decls[0]
+    assert H._stmts_weight(loopy.body) == 21191   # >> MIN_JIT_WEIGHT
+    assert H._stmts_weight(flat.body) == 20       # << MIN_JIT_WEIGHT
+    assert H.MIN_JIT_WEIGHT == 300
+
+
 def test_print_inside_called_fun_never_wrapped():
     # effects hidden behind a helper fun must also block wrapping —
     # a trace-time print would fire once instead of per firing

@@ -1,0 +1,143 @@
+"""Stream (sequence) parallelism over the 8-device virtual mesh
+(parallel/streampar.py): one long stream split contiguously across
+chips — stateless pipelines shard with no collectives; windowed ops
+exchange a window-1 halo with one ppermute hop."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ziria_tpu as z
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.parallel.streampar import (StreamParError, sliding_parallel,
+                                          stream_mesh, stream_parallel)
+
+
+def _mesh():
+    return stream_mesh(8)
+
+
+def test_stateless_pipeline_sharded_equals_single_chip():
+    prog = z.pipe(z.zmap(lambda x: x * 3 + 1, name="affine"),
+                  z.zmap(lambda x: x % 251, name="mod"))
+    xs = np.arange(8 * 513, dtype=np.int32)       # uneven remainder
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_rate_changing_stateless_pipeline():
+    # takes 4 -> emit 1 (sum): iteration = 4 items; shards stay aligned
+    prog = z.zmap(lambda v: jnp.sum(v), in_arity=4, out_arity=1,
+                  name="sum4")
+    xs = np.arange(8 * 64 * 4 + 12, dtype=np.int32)
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_stateful_pipeline_refused():
+    # data-dependent state (cumsum) has no valid fast-forward
+    prog = z.map_accum(lambda s, x: (s + x, s + x), 0, name="cumsum")
+    with pytest.raises(StreamParError, match="advance"):
+        stream_parallel(prog, np.arange(64, dtype=np.int32), _mesh())
+
+
+def test_advance_state_fast_forwarded():
+    # counter state: s' = s + 1 per firing, out = x + s; advance is
+    # closed-form s + n — classic scrambler/derotator shape. Exact
+    # integer equality against the sequential single-chip run,
+    # including the uneven tail.
+    prog = z.pipe(
+        z.zmap(lambda x: x * 2, name="pre"),
+        z.map_accum(lambda s, x: (s + 1, x + s), 7, name="ctr",
+                    advance=lambda s, n: s + n))
+    xs = np.arange(8 * 300 + 13, dtype=np.int32)
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_advance_survives_fold():
+    # map-into-accum fusion must propagate the fast-forward: streampar
+    # documents that stages shard "after fold"
+    from ziria_tpu.core.opt import fold
+    prog = fold(z.pipe(
+        z.zmap(lambda x: x * 2, name="pre"),
+        z.map_accum(lambda s, x: (s + 1, x + s), 7, name="ctr",
+                    advance=lambda s, n: s + n)))
+    xs = np.arange(8 * 64 + 3, dtype=np.int32)
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_advance_lfsr_scrambler_shape():
+    # 3-bit LFSR advanced by matrix power over GF(2): the real
+    # scrambler shape — state is a bit-vector, advance jumps n steps
+    import jax.numpy as jnp
+
+    M = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 0]], np.uint8)
+
+    def step(s, x):
+        out = x ^ s[0]
+        return (jnp.asarray(M, jnp.uint8) @ s) % 2, out
+
+    def mpow(n):
+        r = np.eye(3, dtype=np.uint8)
+        b = M.copy()
+        while n:
+            if n & 1:
+                r = (r @ b) % 2
+            b = (b @ b) % 2
+            n >>= 1
+        return r
+
+    def advance(s, n):
+        return (jnp.asarray(mpow(int(n)), jnp.uint8) @ s) % 2
+
+    prog = z.map_accum(step, np.array([1, 0, 1], np.uint8),
+                       name="lfsr", advance=advance)
+    xs = np.random.default_rng(3).integers(
+        0, 2, 8 * 100 + 5).astype(np.uint8)
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_short_stream_runs_on_tail_path():
+    prog = z.zmap(lambda x: x + 1, name="inc")
+    xs = np.arange(5, dtype=np.int32)             # fewer than 8 devices
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), xs + 1)
+
+
+def test_sliding_parallel_matches_host():
+    # correlation against a fixed 16-tap pattern: outs[i] =
+    # sum(block[i:i+16] * taps)
+    rng = np.random.default_rng(0)
+    taps = jnp.asarray(rng.normal(size=16).astype(np.float32))
+    xs = rng.normal(size=8 * 200).astype(np.float32)
+
+    def corr(block, _t=taps):
+        w = jnp.stack([block[i: i + block.shape[0] - 15]
+                       for i in range(16)], axis=-1)
+        return jnp.sum(w * _t[None, :], axis=-1)
+
+    want = np.asarray(corr(jnp.asarray(xs)))
+    got = sliding_parallel(corr, xs, window=16, mesh=_mesh())
+    np.testing.assert_allclose(np.asarray(got), want,
+                               rtol=1e-5, atol=1e-5)
+    assert got.shape[0] == xs.shape[0] - 15
+
+
+def test_sliding_window_one_is_plain_map():
+    xs = np.arange(8 * 32, dtype=np.float32)
+    got = sliding_parallel(lambda b: b * 2.0, xs, window=1, mesh=_mesh())
+    np.testing.assert_array_equal(np.asarray(got), xs * 2.0)
+
+
+def test_sliding_refuses_tiny_shards():
+    xs = np.arange(16, dtype=np.float32)          # 2 items per device
+    with pytest.raises(StreamParError, match="halo"):
+        sliding_parallel(lambda b: b, xs, window=8, mesh=_mesh())

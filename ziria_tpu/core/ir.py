@@ -244,6 +244,13 @@ class MapAccum(Comp):
     The workhorse for DSP blocks with carried state (scramblers, FIR delay
     lines, phase trackers). Lowers to `jax.lax.scan` over chunks.
     `init` produces the initial state (callable taking no args, or value).
+
+    `advance`, if set, is ``advance(state, n) -> state`` — the state
+    after `n` firings with ANY inputs, for stages whose state evolves
+    independently of the data (LFSR scramblers: M^n·s over GF(2); CFO
+    phase accumulators: ph + n·eps). It lets stream/sequence
+    parallelism (parallel/streampar.py) fast-forward each device's
+    entry state instead of refusing the stage as sequential.
     """
 
     f: Callable[..., Any]
@@ -253,6 +260,8 @@ class MapAccum(Comp):
     name: Optional[str] = None
     in_dtype: Optional[str] = None
     out_dtype: Optional[str] = None
+    advance: Optional[Callable[[Any, int], Any]] = field(
+        default=None, compare=False)
 
     def label(self) -> str:
         return self.name or getattr(self.f, "__name__", "MapAccum")
@@ -408,9 +417,10 @@ def zmap(f: Callable, in_arity: int = 1, out_arity: int = 1,
 
 def map_accum(f: Callable, init: Any, in_arity: int = 1, out_arity: int = 1,
               name: Optional[str] = None, in_dtype: Optional[str] = None,
-              out_dtype: Optional[str] = None) -> Comp:
+              out_dtype: Optional[str] = None,
+              advance: Optional[Callable] = None) -> Comp:
     return MapAccum(f, init, in_arity, out_arity, name, in_dtype,
-                    out_dtype)
+                    out_dtype, advance)
 
 
 def repeat(body: Comp) -> Comp:

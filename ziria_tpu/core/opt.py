@@ -118,10 +118,13 @@ def _map_fusions(c: ir.Comp) -> Optional[ir.Comp]:
             and up.out_arity == down.in_arity):
         def fa(s, x, _f=up.f, _g=down.f):
             return _g(s, _f(x))
+        # the fused stage carries the SAME state with the same
+        # evolution, so the fast-forward stays valid
         return ir.MapAccum(fa, down.init, up.in_arity, down.out_arity,
                            name=f"{down.label()}.{up.label()}",
                            in_dtype=up.in_dtype,
-                           out_dtype=down.out_dtype)
+                           out_dtype=down.out_dtype,
+                           advance=down.advance)
     if (isinstance(up, ir.MapAccum) and isinstance(down, ir.Map)
             and up.out_arity == down.in_arity):
         def fb(s, x, _f=up.f, _g=down.f):
@@ -130,7 +133,8 @@ def _map_fusions(c: ir.Comp) -> Optional[ir.Comp]:
         return ir.MapAccum(fb, up.init, up.in_arity, down.out_arity,
                            name=f"{down.label()}.{up.label()}",
                            in_dtype=up.in_dtype,
-                           out_dtype=down.out_dtype)
+                           out_dtype=down.out_dtype,
+                           advance=up.advance)
     return None
 
 

@@ -332,8 +332,16 @@ def widen_stage(stage: ir.Comp, w: int) -> ir.Comp:
     if isinstance(stage, (ir.MapAccum, ir.JaxBlock)):
         g = _widen_stateful(stage.f, stage.in_arity, stage.out_arity, w)
         if isinstance(stage, ir.MapAccum):
+            adv = stage.advance
+            if adv is not None:
+                # one widened firing = w original firings
+                def adv_w(s, n, _a=adv, _w=w):
+                    return _a(s, n * _w)
+            else:
+                adv_w = None
             return ir.MapAccum(g, stage.init, stage.in_arity,
-                               stage.out_arity, f"{stage.label()}^{w}")
+                               stage.out_arity, f"{stage.label()}^{w}",
+                               advance=adv_w)
         return ir.JaxBlock(g, stage.init, stage.in_arity, stage.out_arity,
                            f"{stage.label()}^{w}")
     if isinstance(stage, ir.Repeat):

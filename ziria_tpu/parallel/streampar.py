@@ -1,0 +1,232 @@
+"""Stream (sequence) parallelism: ONE long stream split across chips.
+
+The reference scales a stream only in time (vectorized chunks) and by
+pipeline stages (`|>>>|` threads); a TPU pod adds the axis the task's
+long-context requirement asks for — split one long stream's ITEMS
+contiguously over an `sp` mesh axis, the way sequence parallelism
+splits a long sequence across devices (jax-ml scaling-book recipe:
+pick a mesh, annotate shardings, let XLA place collectives on ICI).
+
+Two entry points:
+
+- :func:`stream_parallel` — run a static-rate pipeline over one
+  stream with the item axis sharded. Stateless stages (after fold:
+  chains of `Map`s, e.g. demap → deinterleave tables, LUT gathers)
+  shard freely: each device runs the SAME fused step the single-chip
+  backend uses (`backend/lower.py`) on its contiguous slice — no
+  collectives in steady state. Stateful stages join in when their
+  state evolves independently of the data and declares a closed-form
+  fast-forward (``MapAccum.advance(state, n)``: LFSR scramblers are
+  M^n·s over GF(2), CFO derotators are ph + n·eps) — each device's
+  entry state is fast-forwarded to its shard offset, the parallel-
+  prefix trick specialized to constant per-item transforms. Truly
+  sequential state (FIR delay lines over the split boundary) is
+  refused with the dp/pp guidance.
+
+- :func:`sliding_parallel` — the halo-exchange form for windowed ops
+  (correlation, FIR, sliding sums: `ops/sync.py`). Each device holds a
+  contiguous shard plus `window-1` items of LEFT halo fetched from its
+  neighbor with ONE `ppermute` over ICI (the sequence-parallel
+  neighbor exchange), then maps a plain array function over
+  shard+halo. Valid (full) outputs only: N - window + 1 results for N
+  items, exactly like the host-side op.
+
+Both are validated on the 8-device virtual CPU mesh
+(tests/test_streampar.py) and by `__graft_entry__.dryrun_multichip`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ziria_tpu.backend.lower import lower
+from ziria_tpu.core import ir
+
+
+class StreamParError(ValueError):
+    """Pipeline not stream-parallelizable (stateful, or shapes that
+    cannot align to the mesh)."""
+
+
+def stream_mesh(n_devices: Optional[int] = None, axis: str = "sp") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise StreamParError(
+                f"need {n_devices} devices, only {len(devs)} visible")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
+                    axis: str = "sp", width: Optional[int] = None):
+    """Run pipeline `comp` over `inputs` (one stream, leading axis =
+    items) with the stream split contiguously across `mesh`; returns
+    the full output stream (numpy).
+
+    Stages must be stateless, or stateful with a declared fast-forward
+    (``MapAccum.advance(state, n)`` — data-independent state evolution:
+    LFSR scramblers, phase accumulators). Each device's entry state is
+    fast-forwarded to its shard's first firing, so the result is
+    exactly the sequential one. Iterations that don't divide evenly
+    (and the sub-iteration tail) run on the single-chip path with the
+    fast-forwarded tail state, so the result equals `run_jit` on any
+    length.
+    """
+    n_dev = mesh.shape[axis]
+    big = lower(comp, width=width)
+    stages = ir.pipeline_stages(comp)
+    advances = []
+    for s, c0 in zip(stages, big.init_carry):
+        if not jax.tree_util.tree_leaves(c0):
+            advances.append(None)
+            continue
+        adv = getattr(s, "advance", None)
+        if adv is None:
+            raise StreamParError(
+                f"stage {s.label()} has loop-carried state and no "
+                f"advance(state, n) fast-forward; a sequential carry "
+                f"cannot split across a stream — declare one "
+                f"(data-independent state only), or use frame "
+                f"batching (parallel/batch.py) / stage pipelining "
+                f"(parallel/stages.py)")
+        advances.append(adv)
+    stateful = any(a is not None for a in advances)
+
+    def carry_at(iters_done: int):
+        """Stage carries after `iters_done` steady-state iterations."""
+        out = []
+        for j, (s, c0, adv) in enumerate(
+                zip(stages, big.init_carry, advances)):
+            if adv is None:
+                out.append(c0)
+            else:
+                st = adv(s.init_state(), iters_done * big.ss.reps[j])
+                out.append(jax.tree_util.tree_map(jnp.asarray, st))
+        return tuple(out)
+
+    inputs = np.asarray(inputs)
+    n_iters = inputs.shape[0] // big.ss.take
+    if n_iters == 0:
+        # below one steady-state iteration: delegate entirely so the
+        # empty-output conventions match the single-chip path exactly
+        from ziria_tpu.backend.execute import run_jit
+        return run_jit(comp, inputs, width=1)
+
+    # each device gets `per` steady-state iterations, grouped into
+    # bulk steps of `width` iterations = big.take items; when the
+    # planned width exceeds a device's share, re-plan at the share so
+    # short streams still shard instead of falling to the tail path
+    share = n_iters // n_dev
+    if 0 < share < big.width:
+        big = lower(comp, width=share)
+    per = share // big.width * big.width
+    outs = []
+    if per:
+        steps = per // big.width
+        body_items = n_dev * per * big.ss.take
+        bulk = jnp.asarray(
+            inputs[:body_items].reshape(
+                (n_dev * steps, big.take) + inputs.shape[1:]))
+        scan = big.scan_steps()
+        # per-device entry carries, stacked on a leading device axis
+        carries = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[carry_at(d * per) for d in range(n_dev)])
+
+        def shard_body(carry_stack, chunks):
+            # chunks: (steps, take, ...) local; carry leaves: (1, ...)
+            carry = jax.tree_util.tree_map(lambda x: x[0], carry_stack)
+            _, ys = scan(carry, chunks)
+            return ys
+
+        spec = P(axis, *([None] * (bulk.ndim - 1)))
+        run = jax.jit(shard_map(
+            shard_body, mesh=mesh, in_specs=(P(axis), spec),
+            out_specs=spec))
+        with mesh:
+            ys = np.asarray(run(carries, bulk))
+        outs.append(ys.reshape((n_dev * steps * big.emit,)
+                               + ys.shape[2:]))
+        done_iters = n_dev * per
+    else:
+        done_iters = 0
+
+    if done_iters < n_iters:                  # remainder on one device
+        from ziria_tpu.backend.execute import run_jit_carry
+        pos = done_iters * big.ss.take
+        rem = inputs[pos: n_iters * big.ss.take]
+        tail_carry = carry_at(done_iters) if stateful else None
+        # carry structure is width-independent (execute.py), so let the
+        # planner pick the tail width rather than forcing 1
+        tail, _ = run_jit_carry(comp, rem, carry=tail_carry, width=width)
+        outs.append(np.asarray(tail))
+    if not outs:
+        return np.empty((0,) + inputs.shape[1:])
+    return np.concatenate(outs, axis=0)
+
+
+def sliding_parallel(fn: Callable, xs, window: int, mesh: Mesh,
+                     axis: str = "sp"):
+    """Apply windowed `fn` to one long stream split across the mesh.
+
+    `fn(block) -> outs` must map a contiguous block of M items to the
+    M - window + 1 full-window results (e.g. a correlator: outs[i] =
+    f(block[i : i+window])). Each device computes over its shard plus
+    window-1 items of left halo from its neighbor — one `ppermute`
+    hop over ICI, the sequence-parallel halo exchange.
+
+    Returns the N - window + 1 results for the full stream. The stream
+    length must divide evenly by the mesh size (pad upstream if not);
+    shards must be at least window-1 items.
+    """
+    if window < 1:
+        raise StreamParError("window must be >= 1")
+    xs = jnp.asarray(xs)
+    n_dev = mesh.shape[axis]
+    n = xs.shape[0]
+    if n % n_dev:
+        raise StreamParError(
+            f"stream length {n} does not divide over {n_dev} devices; "
+            f"pad to a multiple first")
+    shard = n // n_dev
+    halo = window - 1
+    if halo and shard < halo:
+        raise StreamParError(
+            f"shards of {shard} items are smaller than the "
+            f"window-1 = {halo} halo")
+
+    def body(local):
+        # local: (shard, ...) — fetch the last `halo` items of the LEFT
+        # neighbor (device i-1 sends to i); device 0 pads with zeros,
+        # whose windows are dropped below
+        if halo:
+            tail = local[-halo:]
+            perm = [(i, i + 1) for i in range(n_dev - 1)]
+            recv = jax.lax.ppermute(tail, axis, perm)
+            block = jnp.concatenate([recv, local], axis=0)
+        else:
+            block = local
+        outs = fn(block)                      # (shard + halo) - halo
+        want = shard
+        if outs.shape[0] != want:
+            raise StreamParError(
+                f"fn returned {outs.shape[0]} results for a "
+                f"{block.shape[0]}-item block; expected "
+                f"block - window + 1 = {want}")
+        return outs
+
+    spec = P(axis, *([None] * (xs.ndim - 1)))
+    run = jax.jit(shard_map(body, mesh=mesh, in_specs=spec,
+                            out_specs=spec))
+    with mesh:
+        ys = np.asarray(run(xs))
+    # device 0's first `halo` outputs looked into the zero padding —
+    # the stream's true full windows start at item 0
+    return ys[halo:] if halo else ys

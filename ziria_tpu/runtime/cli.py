@@ -353,16 +353,16 @@ def _run_backend(comp, xs, args, t0):
     else:
         from ziria_tpu.backend.execute import lower, run_jit_carry
         from ziria_tpu.backend.lower import LowerError
-        carry = None
-        if args.state_in:
-            from ziria_tpu.runtime.state import (load_state,
-                                                 program_fingerprint)
-            carry = load_state(args.state_in,
-                               like=lower(comp, width=args.width)
-                               .init_carry,
-                               fingerprint=program_fingerprint(comp))
         stats: Optional[dict] = {} if args.stats else None
         try:
+            carry = None
+            if args.state_in:
+                from ziria_tpu.runtime.state import (load_state,
+                                                     program_fingerprint)
+                carry = load_state(args.state_in,
+                                   like=lower(comp, width=args.width)
+                                   .init_carry,
+                                   fingerprint=program_fingerprint(comp))
             ys, carry = run_jit_carry(comp, xs, carry=carry,
                                       width=args.width, stats_out=stats)
         except LowerError as e:
@@ -378,6 +378,10 @@ def _run_backend(comp, xs, args, t0):
             print(f"note: program has dynamic control "
                   f"({e}); falling back to --backend=hybrid",
                   file=sys.stderr)
+            if args.stats:
+                print("note: --stats reports the fused plan and is "
+                      "unavailable under the hybrid fallback "
+                      "(try --ddump-hybrid)", file=sys.stderr)
             from ziria_tpu.backend.hybrid import hybridize
             from ziria_tpu.interp.interp import run
             res = run(hybridize(comp), list(xs))
