@@ -3,8 +3,9 @@
 Counterpart of the reference RX's front half (SURVEY.md §2.3, §3.4):
 packet detect via STS autocorrelation, coarse/fine CFO from STS/LTS
 lag products, channel estimation from the two LTS symbols. All in pair
-representation, all expressed as whole-array ops (cumulative sums for
-sliding correlations) so a frame's worth of samples is one fused graph.
+representation, all expressed as whole-array ops (short convolutions
+for sliding correlations — see _sliding_sum for why not cumsum) so a
+frame's worth of samples is one fused graph.
 """
 
 from __future__ import annotations
@@ -17,10 +18,35 @@ from ziria_tpu.ops.ofdm import LTS_FREQ, N_FFT
 
 
 def _sliding_sum(x, w: int):
-    """Sliding window sums along axis 0: out[k] = sum(x[k:k+w])."""
-    c = jnp.cumsum(x, axis=0)
-    c = jnp.concatenate([jnp.zeros_like(c[:1]), c], axis=0)
-    return c[w:] - c[:-w]
+    """Sliding window sums along axis 0: out[k] = sum(x[k:k+w]).
+
+    Computed as a w-tap convolution, NOT a global cumsum difference:
+    prefix sums accumulate f32 rounding along the whole stream and the
+    window value c[k+w]-c[k] is a catastrophic cancellation once the
+    prefix dwarfs the window (measured ~0.2% metric error at 14k
+    samples, and host vs stream-sharded results diverged). The conv
+    accumulates only the w local terms, is position-independent — so
+    `parallel/streampar.sliding_parallel` shards bit-compatibly — and
+    a 48-tap conv is nothing on the VPU/MXU.
+    """
+    import jax
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.inexact):
+        # integer windows: cumsum differences are EXACT (no rounding),
+        # and jnp.convolve would promote to float
+        c = jnp.cumsum(x, axis=0)
+        c = jnp.concatenate([jnp.zeros_like(c[:1]), c], axis=0)
+        return c[w:] - c[:-w]
+    k = jnp.ones((w,), x.dtype)
+
+    def conv1(col):
+        return jnp.convolve(col, k, mode="valid")
+
+    if x.ndim == 1:
+        return conv1(x)
+    flat = x.reshape(x.shape[0], -1)
+    out = jax.vmap(conv1, in_axes=1, out_axes=1)(flat)
+    return out.reshape((out.shape[0],) + x.shape[1:])
 
 
 def sts_autocorr(samples, window: int = 48):

@@ -167,8 +167,7 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
         # planner pick the tail width rather than forcing 1
         tail, _ = run_jit_carry(comp, rem, carry=tail_carry, width=width)
         outs.append(np.asarray(tail))
-    if not outs:
-        return np.empty((0,) + inputs.shape[1:])
+    # n_iters >= 1 here, so either the bulk or the tail branch ran
     return np.concatenate(outs, axis=0)
 
 
@@ -223,8 +222,10 @@ def sliding_parallel(fn: Callable, xs, window: int, mesh: Mesh,
         return outs
 
     spec = P(axis, *([None] * (xs.ndim - 1)))
+    # outputs may have a different rank than inputs (e.g. complex pairs
+    # in, scalar metric out): shard only their leading axis
     run = jax.jit(shard_map(body, mesh=mesh, in_specs=spec,
-                            out_specs=spec))
+                            out_specs=P(axis)))
     with mesh:
         ys = np.asarray(run(xs))
     # device 0's first `halo` outputs looked into the zero padding —

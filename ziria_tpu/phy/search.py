@@ -1,0 +1,91 @@
+"""Long-capture packet search — stream parallelism used by the PHY.
+
+The reference receiver detects packets on a live sample stream one at
+a time; an offline TPU workflow wants the dual: scan a LONG capture
+(seconds of IQ samples) for every packet start. The metric is the same
+STS lag-16 autocorrelation the streaming detector uses (ops/sync.py);
+at capture scale it is a windowed map over one long stream, exactly
+the shape `parallel/streampar.sliding_parallel` shards over an `sp`
+mesh axis with a halo exchange (SURVEY.md §2.4's new-capability
+column; validated on the virtual 8-device mesh in tests).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ziria_tpu.ops.sync import sts_autocorr
+
+
+def detection_metric(samples, window: int = 48, mesh=None,
+                     axis: str = "sp"):
+    """STS autocorrelation metric for every window position of a
+    capture. With a mesh, the capture is split across devices with a
+    halo exchange; without, single-device.
+
+    samples: (n, 2) float pairs. Returns (n - 16 - window + 1,) f32.
+    """
+    samples = np.asarray(samples, np.float32)
+    span = 16 + window                        # samples per metric value
+    if mesh is None:
+        m, _ = sts_autocorr(jnp.asarray(samples), window)
+        return np.asarray(m)
+
+    from ziria_tpu.parallel.streampar import sliding_parallel
+    n_dev = mesh.shape[axis]
+    pad = (-len(samples)) % n_dev
+    if pad:
+        # zero samples produce ~zero metric (energy-normalized), and
+        # pad-window values are trimmed below anyway
+        samples = np.concatenate(
+            [samples, np.zeros((pad, 2), np.float32)])
+
+    def fn(block):
+        m, _ = sts_autocorr(block, window)
+        return m
+
+    m = sliding_parallel(fn, samples, window=span, mesh=mesh, axis=axis)
+    return np.asarray(m)[: len(samples) - pad - span + 1] if pad \
+        else np.asarray(m)
+
+
+def find_packets(samples, threshold: float = 0.75, window: int = 48,
+                 min_run: int = 33, min_gap: int = 320, mesh=None,
+                 axis: str = "sp") -> np.ndarray:
+    """Start indices of detection plateaus in a capture.
+
+    A packet start is the first index of a run of at least `min_run`
+    consecutive above-`threshold` windows (the streaming detector's
+    n > 32 plateau requirement — a real STS plateau spans the whole
+    short preamble, while the energy roll-off at a frame's END can
+    produce a brief spurious spike in the normalized metric), at least
+    `min_gap` samples after the previous accepted plateau. Returns
+    sorted indices into `samples`.
+    """
+    metric = detection_metric(samples, window=window, mesh=mesh,
+                              axis=axis)
+    hot = np.flatnonzero(metric > threshold)
+    # group into maximal runs of consecutive indices
+    runs = []
+    start = prev = None
+    for i in hot:
+        i = int(i)
+        if prev is None or i - prev > 1:
+            if start is not None:
+                runs.append((start, prev))
+            start = i
+        prev = i
+    if start is not None:
+        runs.append((start, prev))
+    starts = []
+    last_end = None                 # end of the last ACCEPTED plateau
+    for a, b in runs:
+        if b - a + 1 < min_run:
+            continue
+        if last_end is None or a - last_end > min_gap:
+            starts.append(a)
+            last_end = b
+    return np.asarray(starts, np.int64)
