@@ -35,10 +35,10 @@ def _generator_cases():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return ({name: mode for name, _ty, _mk, mode in mod.CASES},
-            mod.FXP_CASES, mod.INTERP_CASES)
+            mod.FXP_CASES, mod.INTERP_CASES, mod.AUTOLUT_CASES)
 
 
-_MODES, _FXP_CASES, _INTERP_CASES = _generator_cases()
+_MODES, _FXP_CASES, _INTERP_CASES, _AUTOLUT_CASES = _generator_cases()
 
 # quantized complex streams compare with atol=1; float LLR outputs
 # tolerate interp-f64 vs jit-f32 rounding; everything else exact
@@ -69,6 +69,8 @@ def test_golden(name, mode, atol, tmp_path):
     ]
     if name in _FXP_CASES:
         argv.append("--fxp-complex16")
+    if name in _AUTOLUT_CASES:
+        argv.append("--autolut")
     rc = cli_main(argv)
     assert rc == 0
 
