@@ -1,0 +1,85 @@
+"""Run the DSL receiver (examples/wifi_rx.zir) on the REAL TPU via the
+hybrid backend and record the evidence: the same jitted do-blocks the
+CPU tests exercise must compile and run on the chip, bit-identical to
+the interpreter oracle.
+
+    python tools/hybrid_tpu_check.py          # needs the TPU reachable
+
+Emits one JSON line: platform, per-frame cold/warm wall times, and the
+bit-exactness verdict. Wall times include the host-side control loop
+(the hybrid design point), so they are NOT a throughput claim — the
+throughput metric is bench.py's batched library receiver.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import os
+
+    import jax
+
+    # honor the CLI's platform pin so a CPU smoke run refuses fast
+    # instead of touching (and possibly hanging on) the axon backend
+    name = os.environ.get("ZIRIA_PLATFORM")
+    if name:
+        jax.config.update("jax_platforms", name)
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        print(json.dumps({"ok": False, "error": "backend is CPU"}))
+        return 1
+
+    import jax.numpy as jnp
+
+    from ziria_tpu.backend.hybrid import hybridize
+    from ziria_tpu.frontend import compile_file
+    from ziria_tpu.interp.interp import run
+    from ziria_tpu.phy import channel
+    from ziria_tpu.phy.wifi import tx
+
+    rng = np.random.default_rng(42)
+    psdu = rng.integers(0, 256, 90).astype(np.uint8)
+    frame = np.asarray(tx.encode_frame(psdu, 54))
+    x = np.concatenate([
+        rng.normal(scale=0.02, size=(60, 2)).astype(np.float32),
+        np.asarray(channel.apply_cfo(jnp.asarray(frame), 0.002)),
+        rng.normal(scale=0.02, size=(40, 2)).astype(np.float32)])
+    x = (x + rng.normal(scale=0.03, size=x.shape)).astype(np.float32)
+    xi = np.clip(np.round(x * 1024), -32768, 32767).astype(np.int16)
+
+    prog = compile_file("examples/wifi_rx.zir")
+    hyb = hybridize(prog.comp)
+
+    t0 = time.perf_counter()
+    r1 = run(hyb, [p for p in xi])
+    t_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r2 = run(hyb, [p for p in xi])
+    t_warm = time.perf_counter() - t0
+
+    oracle = run(prog.comp, [p for p in xi])
+    a = np.asarray(r1.out_array())
+    ok = (np.array_equal(a, np.asarray(oracle.out_array()))
+          and np.array_equal(a, np.asarray(r2.out_array()))
+          and a.shape[0] == 8 * 90)
+    print(json.dumps({
+        "ok": bool(ok),
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "rate_mbps": 54,
+        "t_cold_s": round(t_cold, 3),
+        "t_warm_s": round(t_warm, 3),
+        "bits": int(a.shape[0]),
+    }))
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
