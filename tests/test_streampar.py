@@ -112,6 +112,67 @@ def test_short_stream_runs_on_tail_path():
     np.testing.assert_array_equal(np.asarray(got), xs + 1)
 
 
+def test_cli_sp_flag(tmp_path):
+    # the driver's --sp=N shards the stream over N local devices and
+    # must reproduce the single-device golden output exactly
+    from ziria_tpu.runtime.cli import main as cli_main
+    src = tmp_path / "sq.zir"
+    src.write_text("""
+      fun sq(x: int32) : int32 { return x * x }
+      let comp main = read[int32] >>> map sq >>> write[int32]
+    """)
+    inf, out1, out8 = (tmp_path / n for n in ("in.dbg", "o1.dbg",
+                                              "o8.dbg"))
+    xs = np.arange(8 * 100 + 3, dtype=np.int32)
+    inf.write_text(",".join(map(str, xs)))
+    base = [f"--src={src}", "--input=file", f"--input-file-name={inf}",
+            "--input-file-mode=dbg", "--output=file",
+            "--output-file-mode=dbg"]
+    assert cli_main(base + [f"--output-file-name={out1}"]) == 0
+    assert cli_main(base + [f"--output-file-name={out8}", "--sp=8"]) == 0
+    assert out1.read_text() == out8.read_text()
+
+
+def test_cli_sp_flag_validation(tmp_path):
+    from ziria_tpu.runtime.cli import main as cli_main
+    src = tmp_path / "id.zir"
+    src.write_text("""
+      fun f(x: int32) : int32 { return x }
+      let comp main = read[int32] >>> map f >>> write[int32]
+    """)
+    inf = tmp_path / "in.dbg"
+    inf.write_text("1,2,3")
+    base = [f"--src={src}", "--input=file", f"--input-file-name={inf}",
+            "--input-file-mode=dbg", "--output=file",
+            f"--output-file-name={tmp_path / 'o.dbg'}",
+            "--output-file-mode=dbg"]
+    with pytest.raises(SystemExit, match="at least 1"):
+        cli_main(base + ["--sp=0"])
+    with pytest.raises(SystemExit, match="needs --backend=jit"):
+        cli_main(base + ["--sp=8", "--backend=hybrid"])
+    with pytest.raises(SystemExit, match="--profile"):
+        cli_main(base + ["--sp=8", "--profile"])
+
+
+def test_cli_sp_refuses_stateful(tmp_path):
+    from ziria_tpu.runtime.cli import main as cli_main
+    src = tmp_path / "acc.zir"
+    src.write_text("""
+      let comp main = read[int32] >>> {
+        var s : int32 := 0;
+        repeat { x <- take; do { s := s + x }; emit s }
+      } >>> write[int32]
+    """)
+    inf = tmp_path / "in.dbg"
+    inf.write_text(",".join(map(str, range(64))))
+    with pytest.raises(SystemExit, match="--sp=8"):
+        cli_main([f"--src={src}", "--input=file",
+                  f"--input-file-name={inf}", "--input-file-mode=dbg",
+                  "--output=file",
+                  f"--output-file-name={tmp_path / 'o.dbg'}",
+                  "--output-file-mode=dbg", "--sp=8"])
+
+
 def test_sliding_parallel_matches_host():
     # correlation against a fixed 16-tap pattern: outs[i] =
     # sum(block[i:i+16] * taps)

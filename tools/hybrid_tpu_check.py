@@ -14,22 +14,26 @@ throughput metric is bench.py's batched library receiver.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+# run as `python tools/hybrid_tpu_check.py`: the script dir is on
+# sys.path, the repo root is not
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
 
 def main() -> int:
-    import os
-
     import jax
 
-    # honor the CLI's platform pin so a CPU smoke run refuses fast
+    # the CLI's platform pin (honors ZIRIA_PLATFORM, guards an
+    # already-initialized backend) so a CPU smoke run refuses fast
     # instead of touching (and possibly hanging on) the axon backend
-    name = os.environ.get("ZIRIA_PLATFORM")
-    if name:
-        jax.config.update("jax_platforms", name)
+    from ziria_tpu.runtime.cli import _apply_platform
+    _apply_platform(None)
 
     dev = jax.devices()[0]
     if dev.platform == "cpu":

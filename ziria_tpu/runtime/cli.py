@@ -150,6 +150,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["interp", "jit", "hybrid"])
     p.add_argument("--width", type=int, default=None,
                    help="vectorization width (default: planner)")
+    p.add_argument("--sp", type=int, default=None, metavar="N",
+                   help="split the stream over N devices (sequence "
+                        "parallelism; jit backend, stateless or "
+                        "fast-forwardable pipelines)")
     p.add_argument("--fold", action="store_true", default=True)
     p.add_argument("--no-fold", dest="fold", action="store_false")
     p.add_argument("--autolut", action="store_true")
@@ -334,6 +338,14 @@ def main(argv=None) -> int:
 
 def _run_backend(comp, xs, args, t0):
     """Dispatch to --profile / interp / jit; returns (ys, seconds)."""
+    if args.sp is not None:
+        # validate up front so the flag can never be silently ignored
+        if args.sp < 1:
+            raise SystemExit(f"--sp={args.sp}: need at least 1 device")
+        if args.backend != "jit" or args.profile:
+            raise SystemExit("--sp needs --backend=jit (sequence "
+                             "parallelism shards the fused pipeline) "
+                             "and cannot combine with --profile")
     if args.profile:
         ys = _run_profiled(comp, xs, args)
         return ys, time.perf_counter() - t0
@@ -353,6 +365,20 @@ def _run_backend(comp, xs, args, t0):
     else:
         from ziria_tpu.backend.execute import lower, run_jit_carry
         from ziria_tpu.backend.lower import LowerError
+        if args.sp is not None:
+            if args.state_in or args.state_out:
+                raise SystemExit("--sp cannot combine with "
+                                 "--state-in/--state-out (the sharded "
+                                 "run has no single carry)")
+            from ziria_tpu.parallel.streampar import (StreamParError,
+                                                      stream_mesh,
+                                                      stream_parallel)
+            try:
+                ys = stream_parallel(comp, xs, stream_mesh(args.sp),
+                                     width=args.width)
+            except (StreamParError, LowerError) as e:
+                raise SystemExit(f"--sp={args.sp}: {e}")
+            return np.asarray(ys), time.perf_counter() - t0
         stats: Optional[dict] = {} if args.stats else None
         try:
             carry = None
