@@ -117,6 +117,32 @@ def test_cli_jit_falls_back_to_hybrid(tmp_path, capsys):
                                   np.asarray(bytes_to_bits(psdu)))
 
 
+def test_cli_profile_handles_dynamic_stage(tmp_path, capsys):
+    # --profile on a dynamic-control program: the dynamic stage falls
+    # back to the hybrid executor inside the per-stage breakdown
+    # instead of crashing with a LowerError
+    from ziria_tpu.runtime.buffers import (StreamSpec, read_stream,
+                                           write_stream)
+    from ziria_tpu.runtime.cli import main as cli_main
+    from ziria_tpu.utils.bits import bytes_to_bits
+    psdu, xi = _capture(6, 30, seed=21)
+    inf, outf = tmp_path / "in.bin", tmp_path / "out.bin"
+    write_stream(StreamSpec(ty="complex16", path=str(inf), mode="bin"), xi)
+    rc = cli_main([
+        f"--src={SRC}", "--profile",
+        "--input=file", f"--input-file-name={inf}",
+        "--input-file-mode=bin",
+        "--output=file", f"--output-file-name={outf}",
+        "--output-file-mode=bin", "--backend=jit",
+    ])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "profile:" in err and "stage" in err
+    got = read_stream(StreamSpec(ty="bit", path=str(outf), mode="bin"))
+    np.testing.assert_array_equal(got[: 8 * 30],
+                                  np.asarray(bytes_to_bits(psdu)))
+
+
 def test_env_ref_shadowing_excluded():
     from ziria_tpu.frontend.elab import _env_ref_names
     env = ir.Env()

@@ -174,7 +174,12 @@ def run_vect(comp: ir.Comp, inputs, plan=None, optimize: bool = False,
     stream = np.asarray(inputs)
     for seg in plan.segments:
         if seg.dynamic:
-            stream = interp.run(seg.comp, stream).out_array()
+            # dynamic segments run under the interpreter driver, but
+            # with their heavy do-blocks jit-compiled (backend/hybrid)
+            # — the mitigator boundary stays a host boundary, the math
+            # inside still reaches XLA
+            from ziria_tpu.backend.hybrid import run_hybrid
+            stream = run_hybrid(seg.comp, stream).out_array()
         else:
             stream = run_jit(seg.comp, stream, width=seg.width)
         if stream.shape[0] == 0:

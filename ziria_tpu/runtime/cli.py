@@ -248,10 +248,24 @@ def _run_profiled(comp, xs, args):
                 return np.asarray(run(_st, list(_cur)).out_array())
         else:
             from ziria_tpu.backend.execute import run_jit_carry
+            from ziria_tpu.backend.lower import LowerError, lower
+            try:
+                lower(st, width=args.width)     # plan only (cheap)
 
-            def go(_st=st, _cur=cur):
-                ys, _ = run_jit_carry(_st, _cur, width=args.width)
-                return np.asarray(ys)
+                def go(_st=st, _cur=cur):
+                    ys, _ = run_jit_carry(_st, _cur, width=args.width)
+                    return np.asarray(ys)
+            except LowerError:
+                # dynamic stage: profile it under the hybrid executor
+                # instead of crashing the breakdown. Hybridize ONCE so
+                # the warm-up pass actually warms the _JitDo caches and
+                # the timed pass measures execution, not recompilation.
+                from ziria_tpu.backend.hybrid import hybridize
+                from ziria_tpu.interp.interp import run
+                hyb = hybridize(st)
+
+                def go(_st=hyb, _cur=cur):
+                    return np.asarray(run(_st, list(_cur)).out_array())
 
         go()                                   # warm-up / compile
         t0 = time.perf_counter()
@@ -373,6 +387,10 @@ def _run_backend(comp, xs, args, t0):
             from ziria_tpu.parallel.streampar import (StreamParError,
                                                       stream_mesh,
                                                       stream_parallel)
+            if args.stats:
+                print("note: --stats reports the single-device fused "
+                      "plan and is unavailable under --sp",
+                      file=sys.stderr)
             try:
                 ys = stream_parallel(comp, xs, stream_mesh(args.sp),
                                      width=args.width)
