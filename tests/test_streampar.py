@@ -58,6 +58,68 @@ def test_advance_state_fast_forwarded():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_memory_fir_fast_warmup():
+    # 5-tap FIR whose state is the last 5 inputs (current included),
+    # so memory=5; each device's entry state comes from a warmup scan
+    # over the preceding items — exact integer equality with the
+    # sequential run, uneven tail too
+    import jax.numpy as jnp
+    taps = np.array([1, -2, 3, -4, 5], np.int32)
+
+    def fir_step(s, x):
+        s2 = jnp.concatenate([s[1:], jnp.asarray(x, jnp.int32)[None]])
+        y = jnp.sum(s2 * jnp.asarray(taps[::-1].copy()))
+        return s2, y
+
+    prog = z.map_accum(fir_step, np.zeros(5, np.int32), name="fir",
+                       memory=5)
+    xs = np.random.default_rng(7).integers(
+        -50, 50, 8 * 200 + 11).astype(np.int32)
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_memory_and_advance_mixed_pipeline():
+    # map >>> counter(advance) >>> fir(memory): all three state classes
+    # in one pipeline, sharded exactly
+    import jax.numpy as jnp
+
+    def fir_step(s, x):
+        s2 = jnp.concatenate([s[1:], jnp.asarray(x, jnp.int32)[None]])
+        return s2, jnp.sum(s2)
+
+    prog = z.pipe(
+        z.zmap(lambda x: x + 1, name="inc"),
+        z.map_accum(lambda s, x: (s + 1, x * s), 1, name="ctr",
+                    advance=lambda s, n: s + n),
+        z.map_accum(fir_step, np.zeros(3, np.int32), name="fir3",
+                    memory=3))
+    xs = np.random.default_rng(8).integers(
+        -9, 9, 8 * 150 + 5).astype(np.int32)
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_memory_survives_fold():
+    import jax.numpy as jnp
+
+    def fir_step(s, x):
+        s2 = jnp.concatenate([s[1:], jnp.asarray(x, jnp.int32)[None]])
+        return s2, jnp.sum(s2)
+
+    from ziria_tpu.core.opt import fold
+    prog = fold(z.pipe(
+        z.zmap(lambda x: x * 3, name="pre"),
+        z.map_accum(fir_step, np.zeros(4, np.int32), name="fir4",
+                    memory=4)))
+    xs = np.arange(8 * 100 + 2, dtype=np.int32)
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_advance_survives_fold():
     # map-into-accum fusion must propagate the fast-forward: streampar
     # documents that stages shard "after fold"

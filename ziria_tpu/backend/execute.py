@@ -156,15 +156,15 @@ def run_vect(comp: ir.Comp, inputs, plan=None, optimize: bool = False,
     """Run a pipeline under the vectorizer's plan (core/vectorize.py).
 
     Static segments run fused under jit at their searched widths;
-    dynamic segments (no static cardinality) run on the interpreter —
-    the host boundary between segments is the mitigator. A fully static
-    pipeline degenerates to ``run_jit`` at the planned width; a fully
-    dynamic one to the interpreter. This is the executable form of the
-    reference's "vectorize what you can, skip what you can't"
-    (SURVEY.md §2.1 Vectorize).
+    dynamic segments (no static cardinality) run under the hybrid
+    executor (interpreter-driven control, heavy do-blocks jitted) —
+    the host boundary between segments is the mitigator. A fully
+    static pipeline degenerates to ``run_jit`` at the planned width; a
+    fully dynamic one to the hybrid executor. This is the executable
+    form of the reference's "vectorize what you can, skip what you
+    can't" (SURVEY.md §2.1 Vectorize).
     """
     from ziria_tpu.core.vectorize import vectorize
-    from ziria_tpu.interp import interp
 
     if optimize:
         from ziria_tpu.core.opt import fold

@@ -251,6 +251,13 @@ class MapAccum(Comp):
     phase accumulators: ph + n·eps). It lets stream/sequence
     parallelism (parallel/streampar.py) fast-forward each device's
     entry state instead of refusing the stage as sequential.
+
+    `memory`, if set, declares FINITE input memory: the state after
+    processing any >= `memory` input items is independent of what came
+    before them (FIR delay lines: memory = taps-1; sliding windows:
+    the window length). Stream parallelism then seeds each device's
+    entry state with a short warmup scan over the `memory` items
+    preceding its shard — exact, no approximation.
     """
 
     f: Callable[..., Any]
@@ -262,6 +269,7 @@ class MapAccum(Comp):
     out_dtype: Optional[str] = None
     advance: Optional[Callable[[Any, int], Any]] = field(
         default=None, compare=False)
+    memory: Optional[int] = field(default=None, compare=False)
 
     def label(self) -> str:
         return self.name or getattr(self.f, "__name__", "MapAccum")
@@ -418,9 +426,10 @@ def zmap(f: Callable, in_arity: int = 1, out_arity: int = 1,
 def map_accum(f: Callable, init: Any, in_arity: int = 1, out_arity: int = 1,
               name: Optional[str] = None, in_dtype: Optional[str] = None,
               out_dtype: Optional[str] = None,
-              advance: Optional[Callable] = None) -> Comp:
+              advance: Optional[Callable] = None,
+              memory: Optional[int] = None) -> Comp:
     return MapAccum(f, init, in_arity, out_arity, name, in_dtype,
-                    out_dtype, advance)
+                    out_dtype, advance, memory)
 
 
 def repeat(body: Comp) -> Comp:

@@ -119,12 +119,17 @@ def _map_fusions(c: ir.Comp) -> Optional[ir.Comp]:
         def fa(s, x, _f=up.f, _g=down.f):
             return _g(s, _f(x))
         # the fused stage carries the SAME state with the same
-        # evolution, so the fast-forward stays valid
+        # evolution, so the fast-forward stays valid; finite memory
+        # rescales from accum-input items to map-input items
+        # (ceil(mem / b) firings x a items each)
+        mem = down.memory
+        if mem is not None and down.in_arity:
+            mem = -(-int(mem) // down.in_arity) * up.in_arity
         return ir.MapAccum(fa, down.init, up.in_arity, down.out_arity,
                            name=f"{down.label()}.{up.label()}",
                            in_dtype=up.in_dtype,
                            out_dtype=down.out_dtype,
-                           advance=down.advance)
+                           advance=down.advance, memory=mem)
     if (isinstance(up, ir.MapAccum) and isinstance(down, ir.Map)
             and up.out_arity == down.in_arity):
         def fb(s, x, _f=up.f, _g=down.f):
@@ -134,7 +139,7 @@ def _map_fusions(c: ir.Comp) -> Optional[ir.Comp]:
                            name=f"{down.label()}.{up.label()}",
                            in_dtype=up.in_dtype,
                            out_dtype=down.out_dtype,
-                           advance=up.advance)
+                           advance=up.advance, memory=up.memory)
     return None
 
 

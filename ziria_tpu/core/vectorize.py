@@ -341,7 +341,7 @@ def widen_stage(stage: ir.Comp, w: int) -> ir.Comp:
                 adv_w = None
             return ir.MapAccum(g, stage.init, stage.in_arity,
                                stage.out_arity, f"{stage.label()}^{w}",
-                               advance=adv_w)
+                               advance=adv_w, memory=stage.memory)
         return ir.JaxBlock(g, stage.init, stage.in_arity, stage.out_arity,
                            f"{stage.label()}^{w}")
     if isinstance(stage, ir.Repeat):

@@ -82,36 +82,60 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
     n_dev = mesh.shape[axis]
     big = lower(comp, width=width)
     stages = ir.pipeline_stages(comp)
-    advances = []
-    for s, c0 in zip(stages, big.init_carry):
+    advances, warm_reqs = [], []
+    for j, (s, c0) in enumerate(zip(stages, big.init_carry)):
         if not jax.tree_util.tree_leaves(c0):
             advances.append(None)
             continue
         adv = getattr(s, "advance", None)
-        if adv is None:
+        mem = getattr(s, "memory", None)
+        if adv is not None:
+            advances.append(adv)
+        elif mem is not None:
+            # finite input memory: the state is exactly reproduced by a
+            # warmup scan over >= `mem` of this stage's input items =
+            # ceil(mem / items-per-iteration) steady-state iterations
+            per_iter = big.ss.reps[j] * max(1, s.in_arity)
+            warm_reqs.append(-(-int(mem) // per_iter))
+            advances.append(None)
+        else:
             raise StreamParError(
-                f"stage {s.label()} has loop-carried state and no "
-                f"advance(state, n) fast-forward; a sequential carry "
-                f"cannot split across a stream — declare one "
-                f"(data-independent state only), or use frame "
-                f"batching (parallel/batch.py) / stage pipelining "
+                f"stage {s.label()} has loop-carried state and neither "
+                f"an advance(state, n) fast-forward nor a finite "
+                f"`memory` declaration; a sequential carry cannot "
+                f"split across a stream — use frame batching "
+                f"(parallel/batch.py) / stage pipelining "
                 f"(parallel/stages.py)")
-        advances.append(adv)
-    stateful = any(a is not None for a in advances)
+    stateful = any(jax.tree_util.tree_leaves(c0)
+                   for c0 in big.init_carry)
+    warm_iters = max(warm_reqs) if warm_reqs else 0
+    small = lower(comp, width=1) if warm_iters else None
+    warm_scan = jax.jit(small.scan_steps()) if warm_iters else None
+
+    inputs = np.asarray(inputs)
 
     def carry_at(iters_done: int):
-        """Stage carries after `iters_done` steady-state iterations."""
-        out = []
+        """Stage carries after `iters_done` steady-state iterations:
+        advance-stages jump analytically; memory-stages are seeded by
+        a warmup scan over the iterations just before the shard."""
+        warm = min(warm_iters, iters_done)
+        base = []
         for j, (s, c0, adv) in enumerate(
                 zip(stages, big.init_carry, advances)):
             if adv is None:
-                out.append(c0)
+                base.append(c0)              # init (memory/stateless)
             else:
-                st = adv(s.init_state(), iters_done * big.ss.reps[j])
-                out.append(jax.tree_util.tree_map(jnp.asarray, st))
-        return tuple(out)
-
-    inputs = np.asarray(inputs)
+                st = adv(s.init_state(),
+                         (iters_done - warm) * big.ss.reps[j])
+                base.append(jax.tree_util.tree_map(jnp.asarray, st))
+        if not warm:
+            return tuple(base)
+        t1 = big.ss.take
+        seg = inputs[(iters_done - warm) * t1: iters_done * t1]
+        chunks = jnp.asarray(
+            seg.reshape((warm, small.take) + inputs.shape[1:]))
+        carry, _ = warm_scan(tuple(base), chunks)
+        return carry
     n_iters = inputs.shape[0] // big.ss.take
     if n_iters == 0:
         # below one steady-state iteration: delegate entirely so the
