@@ -102,6 +102,32 @@ def test_memory_and_advance_mixed_pipeline():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_chained_memory_stages_cascade_warmup():
+    # two FIR stages with a rate change between them: the downstream
+    # delay line ingests the upstream's outputs, so warmups must ADD
+    # (a max-based warmup fed it upstream cold-start values — found by
+    # the executor-agreement fuzzer, seed 4)
+    import jax.numpy as jnp
+
+    def fir(k, name):
+        def step(s, x):
+            s2 = jnp.concatenate([s[1:],
+                                  jnp.asarray(x, jnp.int32)[None]])
+            return s2, jnp.sum(s2)
+        return z.map_accum(step, np.zeros(k, np.int32), name=name,
+                           memory=k)
+
+    prog = z.pipe(fir(5, "a"),
+                  z.zmap(lambda x: jnp.stack([x, -x]), in_arity=1,
+                         out_arity=2, name="expand"),
+                  fir(5, "b"))
+    xs = np.random.default_rng(4).integers(
+        -100, 100, 2427).astype(np.int32)
+    want = run_jit(prog, xs)
+    got = stream_parallel(prog, xs, _mesh())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_memory_survives_fold():
     import jax.numpy as jnp
 

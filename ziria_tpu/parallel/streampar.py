@@ -19,9 +19,12 @@ Two entry points:
   fast-forward (``MapAccum.advance(state, n)``: LFSR scramblers are
   M^n·s over GF(2), CFO derotators are ph + n·eps) — each device's
   entry state is fast-forwarded to its shard offset, the parallel-
-  prefix trick specialized to constant per-item transforms. Truly
-  sequential state (FIR delay lines over the split boundary) is
-  refused with the dp/pp guidance.
+  prefix trick specialized to constant per-item transforms. Stages
+  with FINITE input memory (``MapAccum.memory=K``: FIR delay lines,
+  sliding windows) are seeded by an exact warmup scan over the K
+  items before each shard — requirements cascade (sum) down the
+  pipeline. Truly sequential unbounded state (a cumsum) is refused
+  with the dp/pp guidance.
 
 - :func:`sliding_parallel` — the halo-exchange form for windowed ops
   (correlation, FIR, sliding sums: `ops/sync.py`). Each device holds a
@@ -72,17 +75,20 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
 
     Stages must be stateless, or stateful with a declared fast-forward
     (``MapAccum.advance(state, n)`` — data-independent state evolution:
-    LFSR scramblers, phase accumulators). Each device's entry state is
-    fast-forwarded to its shard's first firing, so the result is
-    exactly the sequential one. Iterations that don't divide evenly
+    LFSR scramblers, phase accumulators) or finite input memory
+    (``MapAccum.memory=K`` — FIR delay lines; entry state seeded by an
+    exact warmup scan over the K preceding items). Each device's entry
+    state is reconstructed at its shard's first firing, so the result
+    is exactly the sequential one. Iterations that don't divide evenly
     (and the sub-iteration tail) run on the single-chip path with the
-    fast-forwarded tail state, so the result equals `run_jit` on any
+    reconstructed tail state, so the result equals `run_jit` on any
     length.
     """
     n_dev = mesh.shape[axis]
     big = lower(comp, width=width)
     stages = ir.pipeline_stages(comp)
-    advances, warm_reqs = [], []
+    advances = []
+    warm_iters = 0
     for j, (s, c0) in enumerate(zip(stages, big.init_carry)):
         if not jax.tree_util.tree_leaves(c0):
             advances.append(None)
@@ -92,11 +98,20 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
         if adv is not None:
             advances.append(adv)
         elif mem is not None:
+            if int(mem) != mem or int(mem) < 1:
+                raise StreamParError(
+                    f"stage {s.label()}: memory={mem!r} must be a "
+                    f"positive integer (items of input history)")
             # finite input memory: the state is exactly reproduced by a
             # warmup scan over >= `mem` of this stage's input items =
-            # ceil(mem / items-per-iteration) steady-state iterations
+            # ceil(mem / items-per-iteration) steady-state iterations.
+            # Requirements CASCADE down the pipeline: this stage's
+            # inputs are only correct once every upstream memory stage
+            # has itself settled, so the totals add (a max would feed
+            # this stage the upstream's cold-start outputs — caught by
+            # the executor-agreement fuzzer, seed 4)
             per_iter = big.ss.reps[j] * max(1, s.in_arity)
-            warm_reqs.append(-(-int(mem) // per_iter))
+            warm_iters += -(-int(mem) // per_iter)
             advances.append(None)
         else:
             raise StreamParError(
@@ -108,7 +123,6 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
                 f"(parallel/stages.py)")
     stateful = any(jax.tree_util.tree_leaves(c0)
                    for c0 in big.init_carry)
-    warm_iters = max(warm_reqs) if warm_reqs else 0
     small = lower(comp, width=1) if warm_iters else None
     warm_scan = jax.jit(small.scan_steps()) if warm_iters else None
 
