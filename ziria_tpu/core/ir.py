@@ -428,6 +428,12 @@ def map_accum(f: Callable, init: Any, in_arity: int = 1, out_arity: int = 1,
               out_dtype: Optional[str] = None,
               advance: Optional[Callable] = None,
               memory: Optional[int] = None) -> Comp:
+    if memory is not None and (int(memory) != memory or int(memory) < 1):
+        # validate at construction so every consumer (fold's rescale,
+        # widening, stream_parallel's warmup budget) sees a sane value
+        raise ValueError(f"map_accum {name or f!r}: memory={memory!r} "
+                         f"must be a positive integer (items of input "
+                         f"history)")
     return MapAccum(f, init, in_arity, out_arity, name, in_dtype,
                     out_dtype, advance, memory)
 
