@@ -67,6 +67,70 @@ def test_hybrid_blocks_actually_jit():
         [w for w in fired if w._broken]
 
 
+def test_bit_arithmetic_promotes_past_uint8_under_jit():
+    # `pw * b` with pw=256 and a data-dependent bit must be 256, not
+    # uint8-wrapped 0: C promotion covers the unsigned narrows on BOTH
+    # paths (found as a SIGNAL-length misparse on 1000-byte frames —
+    # bits 8/9 of the length field silently vanished under jit)
+    from ziria_tpu.backend.execute import run_jit
+    src = """
+    fun weigh(b: arr[12] bit) : int32 {
+      var acc : int32 := 0;
+      var pw : int32 := 1;
+      for t in [0, 12] {
+        acc := acc + pw * b[t];
+        pw := pw * 2
+      }
+      return acc
+    }
+    let comp main = read[bit] >>> repeat {
+      (v : arr[12] bit) <- takes 12; emit weigh(v)
+    } >>> write[int32]
+    """
+    prog = compile_source(src)
+    bits = np.array([0, 0, 0, 1, 0, 1, 1, 1, 1, 1, 0, 0], np.uint8)
+    want = 8 + 32 + 64 + 128 + 256 + 512                 # = 1000
+    got_i = run(prog.comp, list(bits)).out_array()
+    got_j = np.asarray(run_jit(prog.comp, bits))
+    assert int(np.asarray(got_i)[0]) == want
+    assert int(got_j[0]) == want
+
+
+def test_bit_comparison_promotes_under_jit():
+    # C's usual arithmetic conversions apply to comparisons: a bit
+    # compared against a negative/out-of-range value must not demote
+    # the scalar to uint8 on the traced path
+    from ziria_tpu.backend.execute import run_jit
+    src = """
+    fun probe(b: bit) : int32 {
+      var r : int32 := 0;
+      if b > (0 - 1) then { r := 1 };      -- always true in C
+      if b == 256 then { r := r + 10 };    -- never true in C
+      return r
+    }
+    let comp main = read[bit] >>> map probe >>> write[int32]
+    """
+    prog = compile_source(src)
+    bits = np.array([0, 1, 1, 0], np.uint8)
+    want = run(prog.comp, list(bits)).out_array()
+    got = np.asarray(run_jit(prog.comp, bits))
+    np.testing.assert_array_equal(got, np.asarray(want))
+    np.testing.assert_array_equal(got, [1, 1, 1, 1])
+
+
+def test_wifi_rx_hybrid_long_frame():
+    # 1000-byte PSDU at 54 Mbps: the enlarged whole-frame buffers hold
+    # a max-size decode, and the hybrid path matches the transmitted
+    # bits exactly (this length exposed the uint8 promotion bug and
+    # the old 8192-entry buffer cap)
+    from ziria_tpu.utils.bits import bytes_to_bits
+    psdu, xi = _capture(54, 1000, seed=99)
+    prog = compile_file(SRC)
+    out = H.run_hybrid(prog.comp, [p for p in xi]).out_array()
+    np.testing.assert_array_equal(np.asarray(out, np.uint8),
+                                  np.asarray(bytes_to_bits(psdu)))
+
+
 def test_jitdo_writes_back_numpy():
     # refs must come back as numpy so downstream per-item interpretation
     # stays on the fast path

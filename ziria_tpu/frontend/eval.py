@@ -448,19 +448,31 @@ _NP_BIT_OPS = {"&": np.bitwise_and, "|": np.bitwise_or,
                "^": np.bitwise_xor}
 
 
+# C's usual arithmetic conversions apply to COMPARISONS too: without
+# them `bit > -1` or `int8 == 256` silently disagree between the
+# numpy path (strong int64 scalars) and the traced path (weak int32
+# demoting to the narrow dtype)
 _ARITH_PROMOTE = frozenset(("+", "-", "*", "/", "%", "**", "<<", ">>",
-                            "&", "|", "^"))
+                            "&", "|", "^",
+                            "<", "<=", ">", ">=", "==", "!="))
 
 
 def _promote_narrow_np(x: np.ndarray) -> np.ndarray:
-    """C integer promotion: int8/int16 operands widen to int32 before
-    arithmetic, so mid-expression results never wrap at the narrow
-    width (C semantics; ADVICE r1 medium). Narrowing back to the
-    declared width happens at assignment/cast via cast_value — exactly
-    where C truncates. int32/int64 wrap at their own width (= C int /
-    long long); static Python ints are unbounded until assigned, which
-    diverges from C only past 2^63."""
-    if x.dtype in (np.int8, np.int16):
+    """C integer promotion: int8/int16 — and the UNSIGNED narrows,
+    uint8 (the `bit` type) / uint16 — widen to int32 before arithmetic,
+    so mid-expression results never wrap at the narrow width (C
+    semantics; ADVICE r1 medium). Narrowing back to the declared width
+    happens at assignment/cast via cast_value — exactly where C
+    truncates. int32/int64 wrap at their own width (= C int / long
+    long); static Python ints are unbounded until assigned, which
+    diverges from C only past 2^63.
+
+    uint8 matters beyond C-pedantry: without it the two backends
+    DISAGREE — `256 * some_bit` is 256 or 0 depending on path, because
+    np.asarray(256) is a strong int64 scalar while jnp.asarray(256) is
+    a weak int32 that defers to uint8 (found decoding a 1000-byte
+    frame: the SIGNAL length's bit-8/9 terms vanished under jit)."""
+    if x.dtype in (np.int8, np.int16, np.uint8, np.uint16):
         return x.astype(np.int32)
     return x
 
@@ -598,9 +610,9 @@ def _binop(op: str, a: Any, b: Any, loc, fxp: bool = False) -> Any:
     aj, bj = jnp.asarray(a), jnp.asarray(b)
     if op in _ARITH_PROMOTE:
         # C integer promotion, traced path (see _promote_narrow_np)
-        if aj.dtype in (jnp.int8, jnp.int16):
+        if aj.dtype in (jnp.int8, jnp.int16, jnp.uint8, jnp.uint16):
             aj = aj.astype(jnp.int32)
-        if bj.dtype in (jnp.int8, jnp.int16):
+        if bj.dtype in (jnp.int8, jnp.int16, jnp.uint8, jnp.uint16):
             bj = bj.astype(jnp.int32)
     if op in ("+", "-", "*", "**"):
         return {"+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,

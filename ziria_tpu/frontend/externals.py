@@ -234,6 +234,11 @@ def _viterbi_soft(llrs, npairs, nbits):
 
 
 EXTERNALS["viterbi_soft"] = _viterbi_soft
+# same brick under a second name: the ext declaration syntax pins ONE
+# array size per name, and a program decoding both a 24-bit SIGNAL
+# field and max-size DATA frames should not zero a 131072-double
+# buffer on the sync hot path just to decode 24 bits
+EXTERNALS["viterbi_soft_sig"] = _viterbi_soft
 
 
 def register_external(name: str, fn: Callable) -> None:
