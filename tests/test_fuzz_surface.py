@@ -101,6 +101,37 @@ def test_fuzz_surface_backend_agreement(seed):
         got, want, err_msg=f"seed {seed}: jit != interp\n{src}")
 
 
+def test_fuzz_surface_bit_mixing_agreement():
+    # bit (uint8) operands mixed with out-of-range constants and
+    # comparisons — the C-promotion class where the backends silently
+    # diverged (SIGNAL-length bug): random programs over bit arrays
+    for seed in range(8):
+        rng = np.random.default_rng(2000 + seed)
+        terms = []
+        for t in range(int(rng.integers(2, 6))):
+            c = int(rng.integers(1, 1025))
+            i = int(rng.integers(0, 8))
+            if rng.random() < 0.5:
+                terms.append(f"{c} * b[{i}]")
+            else:
+                cmp_v = int(rng.integers(-4, 300))
+                terms.append(f"(if b[{i}] > {cmp_v} then {c} else "
+                             f"(0 - {c}))")
+        body = " + ".join(terms)
+        src = f"""
+fun f(b: arr[8] bit) : int32 {{
+  return {body}
+}}
+let comp main = read[bit] >>> map f >>> write[int32]
+"""
+        xs = rng.integers(0, 2, 8 * 16).astype(np.uint8)
+        prog = compile_source(src)
+        want = np.asarray(run(prog.comp, list(xs)).out_array())
+        got = np.asarray(run_jit(prog.comp, xs))
+        np.testing.assert_array_equal(
+            got, want, err_msg=f"seed {2000+seed}\n{src}")
+
+
 def test_fuzz_surface_int8_autolut_agreement():
     # int8-domain variants additionally run the --autolut rewrite:
     # table gathers must equal both direct paths exactly

@@ -261,6 +261,48 @@ def test_cli_sp_refuses_stateful(tmp_path):
                   "--output-file-mode=dbg", "--sp=8"])
 
 
+def test_stream_parallel_batched_dp_x_sp():
+    # 2x4 mesh: 6 frames over dp=2, each frame's items over sp=4;
+    # stateless + advance stages; equals per-frame run_jit exactly
+    import jax
+    from ziria_tpu.parallel.streampar import stream_parallel_batched
+    devs = jax.devices()[:8]
+    mesh = jax.sharding.Mesh(np.array(devs).reshape(2, 4),
+                             ("dp", "sp"))
+    prog = z.pipe(
+        z.zmap(lambda x: x * 2 + 1, name="aff"),
+        z.map_accum(lambda s, x: (s + 1, x + s), 3, name="ctr",
+                    advance=lambda s, n: s + n))
+    rng = np.random.default_rng(11)
+    B, N = 6, 4 * 128
+    batch = rng.integers(-50, 50, (B, N)).astype(np.int32)
+    got = stream_parallel_batched(prog, batch, mesh)
+    assert got.shape == (B, N)
+    for f in range(B):
+        want = run_jit(prog, batch[f])
+        np.testing.assert_array_equal(got[f], np.asarray(want),
+                                      err_msg=f"frame {f}")
+
+
+def test_stream_parallel_batched_refuses_memory():
+    import jax
+    import jax.numpy as jnp
+    from ziria_tpu.parallel.streampar import stream_parallel_batched
+
+    def fir_step(s, x):
+        s2 = jnp.concatenate([s[1:], jnp.asarray(x, jnp.int32)[None]])
+        return s2, jnp.sum(s2)
+
+    devs = jax.devices()[:8]
+    mesh = jax.sharding.Mesh(np.array(devs).reshape(2, 4),
+                             ("dp", "sp"))
+    prog = z.map_accum(fir_step, np.zeros(3, np.int32), name="fir",
+                       memory=3)
+    with pytest.raises(StreamParError, match="per-frame warmup"):
+        stream_parallel_batched(
+            prog, np.zeros((2, 4 * 32), np.int32), mesh)
+
+
 def test_sliding_parallel_matches_host():
     # correlation against a fixed 16-tap pattern: outs[i] =
     # sum(block[i:i+16] * taps)

@@ -67,6 +67,69 @@ def stream_mesh(n_devices: Optional[int] = None, axis: str = "sp") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def _stage_plan(comp: ir.Comp, big, allow_memory: bool):
+    """Classify every carried stage for sharding: stateless (None),
+    `advance` fast-forward, or finite `memory` (accumulating the
+    cascaded warmup budget). The single source of truth for both the
+    single-stream and the batched (dp x sp) paths — a drifting copy
+    was itself a backend-divergence risk.
+
+    Memory requirements CASCADE down the pipeline: a stage's inputs
+    are only correct once every upstream memory stage has itself
+    settled, so the totals ADD (a max would feed this stage the
+    upstream's cold-start outputs — caught by the executor-agreement
+    fuzzer, seed 4).
+    """
+    stages = ir.pipeline_stages(comp)
+    advances = []
+    warm_iters = 0
+    for j, (s, c0) in enumerate(zip(stages, big.init_carry)):
+        if not jax.tree_util.tree_leaves(c0):
+            advances.append(None)
+            continue
+        adv = getattr(s, "advance", None)
+        mem = getattr(s, "memory", None)
+        if adv is not None:
+            advances.append(adv)
+        elif mem is not None:
+            if not allow_memory:
+                raise StreamParError(
+                    f"stream_parallel_batched: stage {s.label()} — "
+                    f"memory stages need per-frame warmup history; "
+                    f"run stream_parallel per frame instead")
+            if int(mem) != mem or int(mem) < 1:
+                raise StreamParError(
+                    f"stage {s.label()}: memory={mem!r} must be a "
+                    f"positive integer (items of input history)")
+            per_iter = big.ss.reps[j] * max(1, s.in_arity)
+            warm_iters += -(-int(mem) // per_iter)
+            advances.append(None)
+        else:
+            raise StreamParError(
+                f"stage {s.label()} has loop-carried state and neither "
+                f"an advance(state, n) fast-forward nor a finite "
+                f"`memory` declaration; a sequential carry cannot "
+                f"split across a stream — use frame batching "
+                f"(parallel/batch.py) / stage pipelining "
+                f"(parallel/stages.py)")
+    return stages, advances, warm_iters
+
+
+def _fast_forward_carry(stages, big, advances, n_iters: int):
+    """Entry carries after `n_iters` iterations, using analytic
+    fast-forward for advance-stages and init for everything else
+    (memory stages get their warmup applied by the caller)."""
+    out = []
+    for j, (s, c0, adv) in enumerate(
+            zip(stages, big.init_carry, advances)):
+        if adv is None:
+            out.append(c0)
+        else:
+            st = adv(s.init_state(), n_iters * big.ss.reps[j])
+            out.append(jax.tree_util.tree_map(jnp.asarray, st))
+    return tuple(out)
+
+
 def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
                     axis: str = "sp", width: Optional[int] = None):
     """Run pipeline `comp` over `inputs` (one stream, leading axis =
@@ -86,41 +149,8 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
     """
     n_dev = mesh.shape[axis]
     big = lower(comp, width=width)
-    stages = ir.pipeline_stages(comp)
-    advances = []
-    warm_iters = 0
-    for j, (s, c0) in enumerate(zip(stages, big.init_carry)):
-        if not jax.tree_util.tree_leaves(c0):
-            advances.append(None)
-            continue
-        adv = getattr(s, "advance", None)
-        mem = getattr(s, "memory", None)
-        if adv is not None:
-            advances.append(adv)
-        elif mem is not None:
-            if int(mem) != mem or int(mem) < 1:
-                raise StreamParError(
-                    f"stage {s.label()}: memory={mem!r} must be a "
-                    f"positive integer (items of input history)")
-            # finite input memory: the state is exactly reproduced by a
-            # warmup scan over >= `mem` of this stage's input items =
-            # ceil(mem / items-per-iteration) steady-state iterations.
-            # Requirements CASCADE down the pipeline: this stage's
-            # inputs are only correct once every upstream memory stage
-            # has itself settled, so the totals add (a max would feed
-            # this stage the upstream's cold-start outputs — caught by
-            # the executor-agreement fuzzer, seed 4)
-            per_iter = big.ss.reps[j] * max(1, s.in_arity)
-            warm_iters += -(-int(mem) // per_iter)
-            advances.append(None)
-        else:
-            raise StreamParError(
-                f"stage {s.label()} has loop-carried state and neither "
-                f"an advance(state, n) fast-forward nor a finite "
-                f"`memory` declaration; a sequential carry cannot "
-                f"split across a stream — use frame batching "
-                f"(parallel/batch.py) / stage pipelining "
-                f"(parallel/stages.py)")
+    stages, advances, warm_iters = _stage_plan(comp, big,
+                                               allow_memory=True)
     stateful = any(jax.tree_util.tree_leaves(c0)
                    for c0 in big.init_carry)
     small = lower(comp, width=1) if warm_iters else None
@@ -133,22 +163,15 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
         advance-stages jump analytically; memory-stages are seeded by
         a warmup scan over the iterations just before the shard."""
         warm = min(warm_iters, iters_done)
-        base = []
-        for j, (s, c0, adv) in enumerate(
-                zip(stages, big.init_carry, advances)):
-            if adv is None:
-                base.append(c0)              # init (memory/stateless)
-            else:
-                st = adv(s.init_state(),
-                         (iters_done - warm) * big.ss.reps[j])
-                base.append(jax.tree_util.tree_map(jnp.asarray, st))
+        base = _fast_forward_carry(stages, big, advances,
+                                   iters_done - warm)
         if not warm:
-            return tuple(base)
+            return base
         t1 = big.ss.take
         seg = inputs[(iters_done - warm) * t1: iters_done * t1]
         chunks = jnp.asarray(
             seg.reshape((warm, small.take) + inputs.shape[1:]))
-        carry, _ = warm_scan(tuple(base), chunks)
+        carry, _ = warm_scan(base, chunks)
         return carry
     n_iters = inputs.shape[0] // big.ss.take
     if n_iters == 0:
@@ -207,6 +230,86 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
         outs.append(np.asarray(tail))
     # n_iters >= 1 here, so either the bulk or the tail branch ran
     return np.concatenate(outs, axis=0)
+
+
+def stream_parallel_batched(comp: ir.Comp, batch, mesh: Mesh,
+                            dp_axis: str = "dp", sp_axis: str = "sp",
+                            width: Optional[int] = None):
+    """Both new axes at once: a BATCH of independent streams (leading
+    axis = frames) sharded over `dp_axis`, each stream's items split
+    over `sp_axis` — the 2-D composition (dp × sp) of frame batching
+    and sequence parallelism on one mesh.
+
+    Stages must be stateless or declare `advance` (data-independent
+    state): those entry carries are frame-independent, so one set of
+    per-sp-shard carries serves every frame. Finite-`memory` stages
+    are refused here — their entry state depends on each frame's own
+    preceding items (per-frame warmup would need an sp halo exchange;
+    use the single-stream path per frame instead). Streams must divide
+    exactly: frames % dp == 0 and per-frame iterations must align to
+    sp x width — batched decode is a planned layout, not a ragged one
+    (pad upstream), unlike the single-stream path's host tail.
+    """
+    n_dp = mesh.shape[dp_axis]
+    n_sp = mesh.shape[sp_axis]
+    batch = np.asarray(batch)
+    if batch.ndim < 2:
+        raise StreamParError("batch needs (frames, items, ...)")
+    B, N = batch.shape[0], batch.shape[1]
+    if B % n_dp:
+        raise StreamParError(f"{B} frames do not divide over "
+                             f"{n_dp} dp devices")
+    big = lower(comp, width=width)
+    n_iters = N // big.ss.take
+    share = n_iters // n_sp
+    if share == 0:
+        raise StreamParError(
+            f"{n_iters} steady-state iterations cannot split over "
+            f"{n_sp} sp devices")
+    if 0 < share < big.width:
+        big = lower(comp, width=share)
+    per = share // big.width * big.width
+    if per != share or n_iters != share * n_sp \
+            or n_iters * big.ss.take != N:
+        raise StreamParError(
+            f"stream of {N} items must be exactly sp*width-aligned "
+            f"({n_sp} x {big.width} x take {big.ss.take}); pad "
+            f"upstream")
+
+    stages, advances, _warm = _stage_plan(comp, big,
+                                          allow_memory=False)
+    carries = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[_fast_forward_carry(stages, big, advances, d * per)
+          for d in range(n_sp)])
+
+    steps = per // big.width
+    scan = big.scan_steps()
+    # (B, N, ...) -> (dp, B/dp, sp, steps, take, ...)
+    shaped = batch.reshape((n_dp, B // n_dp, n_sp, steps, big.take)
+                           + batch.shape[2:])
+    shaped = jnp.asarray(shaped)
+
+    def shard_body(carry_stack, chunks):
+        # chunks: (1, B/dp, 1, steps, take, ...) local block
+        carry = jax.tree_util.tree_map(lambda x: x[0], carry_stack)
+
+        def one_frame(fr):
+            _, ys = scan(carry, fr[0])
+            return ys
+
+        return jax.vmap(one_frame)(chunks[0])[None, :, None]
+
+    cspec = P(sp_axis)
+    dspec = P(dp_axis, None, sp_axis)
+    run2 = jax.jit(shard_map(shard_body, mesh=mesh,
+                             in_specs=(cspec, dspec),
+                             out_specs=dspec))
+    with mesh:
+        ys = np.asarray(run2(carries, shaped))
+    # (dp, B/dp, sp, steps, emit, ...) -> (B, sp*steps*emit, ...)
+    ys = ys.reshape((B, n_sp * steps * big.emit) + ys.shape[5:])
+    return ys
 
 
 def sliding_parallel(fn: Callable, xs, window: int, mesh: Mesh,
