@@ -1,0 +1,103 @@
+"""Auto-pipelining: place an UNANNOTATED pipeline across devices.
+
+The reference's auto-pipelining pass splits the computation graph at
+`|>>>|` into threads (SURVEY.md §2.1, §3.3) — but the programmer has
+to write the `|>>>|`. This pass writes it for them: given a mesh axis
+of K devices, partition the flattened stage list into K contiguous
+segments balancing estimated per-iteration cost, insert the ParPipe
+boundaries, and hand the result to `parallel/stages.py`'s existing
+stage-parallel lowering (one segment per device, chunks advancing via
+`ppermute` over ICI).
+
+Cost model: items moved per steady-state iteration
+(`reps * (in_arity + out_arity)`) — a bandwidth proxy that weights
+rate-expanded stages correctly without needing per-op FLOP counts.
+Callers with better knowledge (e.g. measured stage times from
+`--profile`) pass their own `cost_fn`; the balanced-partition DP is
+cost-model-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from ziria_tpu.core import ir
+from ziria_tpu.core.card import steady_state
+
+
+class AutoSplitError(ValueError):
+    pass
+
+
+def default_stage_cost(stage: ir.Comp, reps: int) -> float:
+    """Items moved per steady-state iteration — the bandwidth proxy."""
+    a = getattr(stage, "in_arity", 1) or 1
+    b = getattr(stage, "out_arity", 1) or 1
+    return float(reps * (a + b))
+
+
+def balanced_partition(costs: Sequence[float], k: int) -> List[int]:
+    """Split `costs` into k contiguous groups minimizing the maximum
+    group sum; returns the k-1 cut indices (group j = costs[cut[j-1]:
+    cut[j]]). Classic O(n^2 k) DP — stage lists are tiny."""
+    n = len(costs)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def seg(i, j):                    # cost of stages [i, j)
+        return prefix[j] - prefix[i]
+
+    INF = float("inf")
+    # best[j][m] = minimal max-cost splitting first j stages into m groups
+    best = [[INF] * (k + 1) for _ in range(n + 1)]
+    cut = [[0] * (k + 1) for _ in range(n + 1)]
+    best[0][0] = 0.0
+    for m in range(1, k + 1):
+        for j in range(m, n - (k - m) + 1):
+            for i in range(m - 1, j):
+                v = max(best[i][m - 1], seg(i, j))
+                if v < best[j][m]:
+                    best[j][m] = v
+                    cut[j][m] = i
+    cuts = []
+    j, m = n, k
+    while m > 1:
+        i = cut[j][m]
+        cuts.append(i)
+        j, m = i, m - 1
+    cuts.reverse()
+    return cuts
+
+
+def auto_pipeline(comp: ir.Comp, n_segments: int,
+                  cost_fn: Optional[Callable] = None) -> ir.Comp:
+    """Rewrite `comp` (a static-rate `>>>` pipeline) into `n_segments`
+    ParPipe segments with balanced estimated cost. Existing ParPipe
+    annotations are flattened and re-decided — this IS the decision
+    pass. Returns the annotated comp for `lower_stage_parallel`."""
+    flat = []
+    for seg in ir.par_segments(comp):
+        flat.extend(ir.pipeline_stages(seg))
+    if n_segments < 1:
+        raise AutoSplitError("need at least one segment")
+    if n_segments > len(flat):
+        raise AutoSplitError(
+            f"cannot split {len(flat)} stage(s) into {n_segments} "
+            f"segments; reduce the axis or widen the program")
+    ss = steady_state(flat)
+    if ss is None:
+        raise AutoSplitError(
+            "auto-pipelining needs a static steady state; dynamic "
+            "pipelines run on the hybrid executor instead")
+    fn = cost_fn or default_stage_cost
+    costs = [fn(s, r) for s, r in zip(flat, ss.reps)]
+    cuts = [0] + balanced_partition(costs, n_segments) + [len(flat)]
+    groups = []
+    for a, b in zip(cuts[:-1], cuts[1:]):
+        seg_stages = flat[a:b]
+        g = seg_stages[0]
+        for s in seg_stages[1:]:
+            g = ir.Pipe(g, s)
+        groups.append(g)
+    return ir.par_pipe(*groups)

@@ -154,6 +154,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="split the stream over N devices (sequence "
                         "parallelism; jit backend, stateless or "
                         "fast-forwardable pipelines)")
+    p.add_argument("--pp", type=int, default=None, metavar="N",
+                   help="auto-pipeline the stages across N devices "
+                        "(balanced |>>>| placement decided by the "
+                        "compiler; jit backend)")
     p.add_argument("--fold", action="store_true", default=True)
     p.add_argument("--no-fold", dest="fold", action="store_false")
     p.add_argument("--autolut", action="store_true")
@@ -301,6 +305,18 @@ def main(argv=None) -> int:
     if args.autolut:
         from ziria_tpu.core.autolut import autolut
         comp = autolut(comp)
+    if args.pp is not None and args.pp >= 1:
+        # decide |>>>| placement BEFORE folding: fold fuses across >>>
+        # (collapsing the stages we want to distribute) but respects
+        # ParPipe boundaries, so each decided segment still fuses
+        # internally. --pp=1 also goes through the pass: any existing
+        # |>>>| annotations are flattened onto the single device
+        from ziria_tpu.parallel.autosplit import (AutoSplitError,
+                                                  auto_pipeline)
+        try:
+            comp = auto_pipeline(comp, args.pp)
+        except AutoSplitError as e:
+            raise SystemExit(f"--pp={args.pp}: {e}")
     if args.fold:
         from ziria_tpu.core.opt import fold
         comp = fold(comp)
@@ -350,6 +366,38 @@ def main(argv=None) -> int:
     return 0
 
 
+def _run_auto_pp(comp, xs, args, t0):
+    """--pp=N: compiler-decided stage placement across N devices (the
+    reference's auto-pipelining pass, minus the hand-written |>>>|)."""
+    import jax
+
+    from ziria_tpu.backend.lower import LowerError
+    from ziria_tpu.parallel.stages import lower_stage_parallel
+    from ziria_tpu.parallel.streampar import (StreamParError,
+                                              stream_mesh)
+
+    if args.stats:
+        print("note: --stats reports the fused single-device plan and "
+              "is unavailable under --pp", file=sys.stderr)
+    try:
+        mesh = stream_mesh(args.pp, axis="pp")
+        # main() already decided the ParPipe placement (pre-fold)
+        pp = lower_stage_parallel(
+            comp, mesh, width=args.width or 1,
+            in_item=jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype))
+    except (LowerError, StreamParError) as e:
+        raise SystemExit(f"--pp={args.pp}: {e}")
+    if xs.shape[0] % pp.take:
+        raise SystemExit(
+            f"--pp={args.pp}: stream of {xs.shape[0]} items must be a "
+            f"multiple of the pipeline's macro chunk ({pp.take}); pad "
+            f"the input")
+    m = xs.shape[0] // pp.take
+    ys = np.asarray(pp.run(xs.reshape((m, pp.take) + xs.shape[1:])))
+    return (ys.reshape((m * pp.emit,) + ys.shape[2:]),
+            time.perf_counter() - t0)
+
+
 def _run_backend(comp, xs, args, t0):
     """Dispatch to --profile / interp / jit; returns (ys, seconds)."""
     if args.sp is not None:
@@ -360,6 +408,14 @@ def _run_backend(comp, xs, args, t0):
             raise SystemExit("--sp needs --backend=jit (sequence "
                              "parallelism shards the fused pipeline) "
                              "and cannot combine with --profile")
+    if args.pp is not None:
+        if args.pp < 1:
+            raise SystemExit(f"--pp={args.pp}: need at least 1 device")
+        if args.backend != "jit" or args.profile or args.sp is not None \
+                or args.state_in or args.state_out:
+            raise SystemExit("--pp needs --backend=jit and cannot "
+                             "combine with --sp/--profile/--state-*")
+        return _run_auto_pp(comp, xs, args, t0)
     if args.profile:
         ys = _run_profiled(comp, xs, args)
         return ys, time.perf_counter() - t0
