@@ -61,6 +61,28 @@ def test_auto_pipeline_weights_by_rate():
     assert [len(ir.pipeline_stages(s)) for s in segs] == [3, 2]
 
 
+def test_nested_parpipe_flattened_to_fixpoint():
+    # a ParPipe nested UNDER a Pipe (parenthesized |>>>| in source)
+    # must be flattened and re-decided, not survive as an opaque stage
+    a = z.zmap(lambda x: x + 1, name="a")
+    b = z.zmap(lambda x: x + 2, name="b")
+    c = z.zmap(lambda x: x + 3, name="c")
+    comp = ir.Pipe(a, ir.ParPipe(b, c))
+    comp2 = auto_pipeline(comp, 2)
+    assert len(ir.par_segments(comp2)) == 2
+    assert sum(len(ir.pipeline_stages(s))
+               for s in ir.par_segments(comp2)) == 3
+
+
+def test_cost_uses_cardinality_for_repeat_stages():
+    from ziria_tpu.parallel.autosplit import default_stage_cost
+    # repeat { takes 64; emit sum } moves 65 items per firing
+    rep = z.repeat(z.let("v", z.takes(64),
+                         z.emit(lambda env: env["v"].sum())))
+    assert default_stage_cost(rep, 1) == 65.0
+    assert default_stage_cost(z.zmap(lambda x: x, name="m"), 3) == 6.0
+
+
 def test_auto_pipeline_refuses_oversplit():
     prog = z.pipe(z.zmap(lambda x: x, name="a"),
                   z.zmap(lambda x: x, name="b"))

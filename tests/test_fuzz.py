@@ -11,6 +11,7 @@ import pytest
 
 import ziria_tpu as z
 from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.core import ir
 from ziria_tpu.core.opt import fold
 from ziria_tpu.interp.interp import run
 from ziria_tpu.parallel.streampar import (StreamParError, stream_mesh,
@@ -91,3 +92,26 @@ def test_fuzz_executor_agreement(seed):
         pytest.fail(f"seed {seed}: stream_parallel refused: {e}")
     np.testing.assert_array_equal(
         got_sp, got_jit, err_msg=f"seed {seed} (sp)")
+
+    # auto-pipelined placement across 2 devices must also agree (on
+    # its exact-macro-chunk prefix; fill/drain handles the rest)
+    stages = ir.pipeline_stages(comp)
+    if len(stages) >= 2:
+        import jax
+
+        from ziria_tpu.parallel.autosplit import auto_pipeline
+        from ziria_tpu.parallel.stages import lower_stage_parallel
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("pp",))
+        pp = lower_stage_parallel(
+            auto_pipeline(comp, 2), mesh,
+            in_item=jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype),
+            width=2)
+        m = xs.shape[0] // pp.take
+        if m:
+            ys = np.asarray(
+                pp.run(xs[: m * pp.take].reshape(
+                    (m, pp.take) + xs.shape[1:])))
+            flat = ys.reshape((m * pp.emit,) + ys.shape[2:])
+            np.testing.assert_array_equal(
+                flat, got_jit[: flat.shape[0]],
+                err_msg=f"seed {seed} (pp)")

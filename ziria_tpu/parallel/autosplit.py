@@ -22,18 +22,36 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Sequence
 
 from ziria_tpu.core import ir
-from ziria_tpu.core.card import steady_state
+from ziria_tpu.core.card import CCard, TCard, cardinality, steady_state
 
 
 class AutoSplitError(ValueError):
     pass
 
 
+def _flatten(comp: ir.Comp) -> List[ir.Comp]:
+    """Fully decompose >>> AND |>>>| into the leaf stage list — to a
+    fixpoint, so a ParPipe nested under a Pipe (parenthesized source)
+    can never survive as an opaque 'stage'."""
+    if isinstance(comp, (ir.Pipe, ir.ParPipe)):
+        return _flatten(comp.up) + _flatten(comp.down)
+    return [comp]
+
+
 def default_stage_cost(stage: ir.Comp, reps: int) -> float:
-    """Items moved per steady-state iteration — the bandwidth proxy."""
-    a = getattr(stage, "in_arity", 1) or 1
-    b = getattr(stage, "out_arity", 1) or 1
-    return float(reps * (a + b))
+    """Items moved per steady-state iteration — the bandwidth proxy.
+    Rates come from the cardinality analysis (a `repeat { takes 64;
+    emit .. }` moves 65 items per firing, not 2), falling back to the
+    arity fields only when no static cardinality exists."""
+    c = cardinality(stage)
+    if isinstance(c, TCard):
+        i, o = c.i, c.o
+    elif isinstance(c, CCard):
+        i, o = c.take, c.emit
+    else:
+        i = getattr(stage, "in_arity", 1) or 1
+        o = getattr(stage, "out_arity", 1) or 1
+    return float(reps * (max(i, 1) + max(o, 1)))
 
 
 def balanced_partition(costs: Sequence[float], k: int) -> List[int]:
@@ -76,9 +94,7 @@ def auto_pipeline(comp: ir.Comp, n_segments: int,
     ParPipe segments with balanced estimated cost. Existing ParPipe
     annotations are flattened and re-decided — this IS the decision
     pass. Returns the annotated comp for `lower_stage_parallel`."""
-    flat = []
-    for seg in ir.par_segments(comp):
-        flat.extend(ir.pipeline_stages(seg))
+    flat = _flatten(comp)
     if n_segments < 1:
         raise AutoSplitError("need at least one segment")
     if n_segments > len(flat):

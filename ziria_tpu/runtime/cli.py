@@ -379,11 +379,17 @@ def _run_auto_pp(comp, xs, args, t0):
     if args.stats:
         print("note: --stats reports the fused single-device plan and "
               "is unavailable under --pp", file=sys.stderr)
+    if args.width is not None and args.width < 1:
+        raise SystemExit(f"--width={args.width}: must be >= 1")
+    if args.width is None:
+        print("note: --pp segments run at width 1; pass --width=W to "
+              "vectorize each segment (widths multiply the macro "
+              "chunk the input length must divide)", file=sys.stderr)
     try:
         mesh = stream_mesh(args.pp, axis="pp")
         # main() already decided the ParPipe placement (pre-fold)
         pp = lower_stage_parallel(
-            comp, mesh, width=args.width or 1,
+            comp, mesh, width=args.width if args.width else 1,
             in_item=jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype))
     except (LowerError, StreamParError) as e:
         raise SystemExit(f"--pp={args.pp}: {e}")
