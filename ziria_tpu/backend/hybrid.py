@@ -94,10 +94,16 @@ def _has_effects(stmts, ctx=None, _seen: Optional[set] = None) -> bool:
 # ------------------------------------------------------------ env pytree
 
 
-def _env_signature(env: ir.Env) -> Tuple[Tuple, List[Any]]:
+def _env_signature(env: ir.Env, keep=None,
+                   writes=None) -> Tuple[Tuple, List[Any]]:
     """Flatten the env chain to (structure, values). Structure is a
-    hashable per-level tuple of (var names, ref names) outermost-first;
-    values align with it."""
+    hashable per-level tuple of (var names, ref names, written-ref
+    names) outermost-first; values align with the first two.
+
+    `keep`/`writes` slice the env to the block's syntactic read/write
+    sets: a do-block next to a 131072-entry frame buffer it never
+    touches must not ship that buffer to the device and back on every
+    firing (measured: the whole win disappeared into env traffic)."""
     levels = []
     e = env
     while e is not None:
@@ -106,9 +112,13 @@ def _env_signature(env: ir.Env) -> Tuple[Tuple, List[Any]]:
     levels.reverse()
     struct, vals = [], []
     for lv in levels:
-        vnames = tuple(lv._vars.keys())
-        rnames = tuple(lv._refs.keys())
-        struct.append((vnames, rnames))
+        vnames = tuple(n for n in lv._vars
+                       if keep is None or n in keep)
+        rnames = tuple(n for n in lv._refs
+                       if keep is None or n in keep)
+        wnames = tuple(n for n in rnames
+                       if writes is None or n in writes)
+        struct.append((vnames, rnames, wnames))
         vals.extend(lv._vars[n] for n in vnames)
         vals.extend(lv._refs[n] for n in rnames)
     return tuple(struct), vals
@@ -117,7 +127,7 @@ def _env_signature(env: ir.Env) -> Tuple[Tuple, List[Any]]:
 def _env_rebuild(struct: Tuple, vals: List[Any]) -> ir.Env:
     env = None
     it = iter(vals)
-    for vnames, rnames in struct:
+    for vnames, rnames, _wn in struct:
         env = ir.Env(env)
         for n in vnames:
             env.bind(n, next(it))
@@ -127,7 +137,7 @@ def _env_rebuild(struct: Tuple, vals: List[Any]) -> ir.Env:
 
 
 def _env_refs(env: ir.Env, struct: Tuple) -> List[Any]:
-    """Ref values in structure order (outermost level first)."""
+    """WRITTEN ref values in structure order (outermost level first)."""
     levels = []
     e = env
     while e is not None:
@@ -135,8 +145,8 @@ def _env_refs(env: ir.Env, struct: Tuple) -> List[Any]:
         e = e._parent
     levels.reverse()
     out = []
-    for lv, (_vn, rnames) in zip(levels, struct):
-        out.extend(lv._refs[n] for n in rnames)
+    for lv, (_vn, _rn, wnames) in zip(levels, struct):
+        out.extend(lv._refs[n] for n in wnames)
     return out
 
 
@@ -148,8 +158,8 @@ def _env_write_refs(env: ir.Env, struct: Tuple, vals: List[Any]) -> None:
         e = e._parent
     levels.reverse()
     it = iter(vals)
-    for lv, (_vn, rnames) in zip(levels, struct):
-        for n in rnames:
+    for lv, (_vn, _rn, wnames) in zip(levels, struct):
+        for n in wnames:
             lv._refs[n] = next(it)
 
 
@@ -162,13 +172,26 @@ class _JitDo:
         self.closure = closure
         self._fns: Dict[Tuple, Any] = {}
         self._broken = False
+        # syntactic read/write sets slice the env: only touched names
+        # cross the host<->device boundary per firing
+        stmts = getattr(closure, "z_stmts", None)
+        if stmts is not None:
+            from ziria_tpu.frontend.eval import _stmt_reads, _stmt_writes
+            reads: set = set()
+            writes: set = set()
+            _stmt_reads(stmts, reads)
+            _stmt_writes(stmts, writes)
+            self._keep = frozenset(reads | writes)
+            self._writes = frozenset(writes)
+        else:                     # pragma: no cover - wrapped closures
+            self._keep = self._writes = None
 
     def __call__(self, env: ir.Env):
         if self._broken:
             return self.closure(env)
         import jax
         try:
-            struct, vals = _env_signature(env)
+            struct, vals = _env_signature(env, self._keep, self._writes)
         except Exception:
             self._broken = True
             return self.closure(env)
@@ -191,11 +214,20 @@ class _JitDo:
             # semantics preserved
             self._broken = True
             return self.closure(env)
-        # device -> numpy on the way out: the surrounding interpreter's
-        # per-item work runs ~50x faster on numpy than through jnp
-        # dispatch, so leaving jax Arrays in the refs would poison every
-        # downstream sample loop (measured: erased the whole win)
-        host = jax.tree_util.tree_map(np.asarray, (ret, list(refs)))
+        # device -> numpy on the way out for SMALL leaves: the
+        # surrounding interpreter's per-item work runs ~50x faster on
+        # numpy than through jnp dispatch, so leaving jax Arrays in
+        # scalar/control refs would poison every downstream sample loop
+        # (measured: erased the whole win). LARGE arrays stay on the
+        # device — they are frame buffers flowing into the NEXT jit
+        # block (or a jax-capable ext), and converting them forced a
+        # 0.5 MB sync/copy per symbol for data the host never touches.
+        def out(x):
+            if hasattr(x, "size") and x.size > 4096:
+                return x
+            return np.asarray(x)
+
+        host = jax.tree_util.tree_map(out, (ret, list(refs)))
         ret, refs = host
         _env_write_refs(env, struct, refs)
         return ret

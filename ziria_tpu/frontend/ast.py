@@ -552,12 +552,22 @@ def iter_exprs(e: Optional[Expr]):
         yield from iter_exprs(k)
 
 
+def _ty_dim_exprs(ty: Optional[Ty]):
+    """Array-dimension expressions inside a type annotation — they are
+    READS (a sliced environment must ship `n` for `arr[n] double`)."""
+    while isinstance(ty, TArr):
+        if ty.n is not None:
+            yield ty.n
+        ty = ty.elem
+
+
 def stmt_exprs(st: Stmt):
-    """Expressions appearing directly in `st` (not in nested stmts)."""
+    """Expressions appearing directly in `st` (not in nested stmts),
+    including array dimensions in declared types."""
     if isinstance(st, SVar):
-        kids = (st.init,)
+        kids = (st.init,) + tuple(_ty_dim_exprs(st.ty))
     elif isinstance(st, SLet):
-        kids = (st.e,)
+        kids = (st.e,) + tuple(_ty_dim_exprs(st.ty))
     elif isinstance(st, SAssign):
         kids = (st.lval, st.e)
     elif isinstance(st, SIf):
