@@ -284,7 +284,10 @@ def test_stream_parallel_batched_dp_x_sp():
                                       err_msg=f"frame {f}")
 
 
-def test_stream_parallel_batched_refuses_memory():
+def test_stream_parallel_batched_memory_per_frame_warmup():
+    # finite-memory stages now join the batched path: each (frame,
+    # shard) entry state is seeded from that FRAME's own preceding
+    # items — exact equality with per-frame run_jit
     import jax
     import jax.numpy as jnp
     from ziria_tpu.parallel.streampar import stream_parallel_batched
@@ -296,9 +299,28 @@ def test_stream_parallel_batched_refuses_memory():
     devs = jax.devices()[:8]
     mesh = jax.sharding.Mesh(np.array(devs).reshape(2, 4),
                              ("dp", "sp"))
-    prog = z.map_accum(fir_step, np.zeros(3, np.int32), name="fir",
-                       memory=3)
-    with pytest.raises(StreamParError, match="per-frame warmup"):
+    prog = z.pipe(
+        z.zmap(lambda x: x * 2, name="pre"),
+        z.map_accum(fir_step, np.zeros(3, np.int32), name="fir",
+                    memory=3))
+    rng = np.random.default_rng(17)
+    batch = rng.integers(-40, 40, (4, 4 * 64)).astype(np.int32)
+    got = stream_parallel_batched(prog, batch, mesh)
+    for f in range(4):
+        want = run_jit(prog, batch[f])
+        np.testing.assert_array_equal(got[f], np.asarray(want),
+                                      err_msg=f"frame {f}")
+
+
+def test_stream_parallel_batched_refuses_raw_state():
+    import jax
+    from ziria_tpu.parallel.streampar import stream_parallel_batched
+
+    devs = jax.devices()[:8]
+    mesh = jax.sharding.Mesh(np.array(devs).reshape(2, 4),
+                             ("dp", "sp"))
+    prog = z.map_accum(lambda s, x: (s + x, s + x), 0, name="cumsum")
+    with pytest.raises(StreamParError, match="advance"):
         stream_parallel_batched(
             prog, np.zeros((2, 4 * 32), np.int32), mesh)
 

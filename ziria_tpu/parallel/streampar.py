@@ -67,7 +67,7 @@ def stream_mesh(n_devices: Optional[int] = None, axis: str = "sp") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
-def _stage_plan(comp: ir.Comp, big, allow_memory: bool):
+def _stage_plan(comp: ir.Comp, big):
     """Classify every carried stage for sharding: stateless (None),
     `advance` fast-forward, or finite `memory` (accumulating the
     cascaded warmup budget). The single source of truth for both the
@@ -92,11 +92,6 @@ def _stage_plan(comp: ir.Comp, big, allow_memory: bool):
         if adv is not None:
             advances.append(adv)
         elif mem is not None:
-            if not allow_memory:
-                raise StreamParError(
-                    f"stream_parallel_batched: stage {s.label()} — "
-                    f"memory stages need per-frame warmup history; "
-                    f"run stream_parallel per frame instead")
             if int(mem) != mem or int(mem) < 1:
                 raise StreamParError(
                     f"stage {s.label()}: memory={mem!r} must be a "
@@ -130,6 +125,31 @@ def _fast_forward_carry(stages, big, advances, n_iters: int):
     return tuple(out)
 
 
+def _entry_carry_fn(comp, big, stages, advances, warm_iters: int):
+    """carry_at(iters_done, items) shared by the single-stream and
+    batched paths: analytic fast-forward plus (when any stage declares
+    finite memory) a warmup scan over the `items` just before the
+    shard. `items` is the stream the shard belongs to — for the
+    batched path, each FRAME's own items."""
+    small = lower(comp, width=1) if warm_iters else None
+    warm_scan = jax.jit(small.scan_steps()) if warm_iters else None
+
+    def carry_at(iters_done: int, items):
+        warm = min(warm_iters, iters_done)
+        base = _fast_forward_carry(stages, big, advances,
+                                   iters_done - warm)
+        if not warm:
+            return base
+        t1 = big.ss.take
+        seg = items[(iters_done - warm) * t1: iters_done * t1]
+        chunks = jnp.asarray(
+            seg.reshape((warm, small.take) + items.shape[1:]))
+        carry, _ = warm_scan(base, chunks)
+        return carry
+
+    return carry_at
+
+
 def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
                     axis: str = "sp", width: Optional[int] = None):
     """Run pipeline `comp` over `inputs` (one stream, leading axis =
@@ -149,30 +169,14 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
     """
     n_dev = mesh.shape[axis]
     big = lower(comp, width=width)
-    stages, advances, warm_iters = _stage_plan(comp, big,
-                                               allow_memory=True)
+    stages, advances, warm_iters = _stage_plan(comp, big)
     stateful = any(jax.tree_util.tree_leaves(c0)
                    for c0 in big.init_carry)
-    small = lower(comp, width=1) if warm_iters else None
-    warm_scan = jax.jit(small.scan_steps()) if warm_iters else None
-
     inputs = np.asarray(inputs)
+    _carry_at = _entry_carry_fn(comp, big, stages, advances, warm_iters)
 
     def carry_at(iters_done: int):
-        """Stage carries after `iters_done` steady-state iterations:
-        advance-stages jump analytically; memory-stages are seeded by
-        a warmup scan over the iterations just before the shard."""
-        warm = min(warm_iters, iters_done)
-        base = _fast_forward_carry(stages, big, advances,
-                                   iters_done - warm)
-        if not warm:
-            return base
-        t1 = big.ss.take
-        seg = inputs[(iters_done - warm) * t1: iters_done * t1]
-        chunks = jnp.asarray(
-            seg.reshape((warm, small.take) + inputs.shape[1:]))
-        carry, _ = warm_scan(base, chunks)
-        return carry
+        return _carry_at(iters_done, inputs)
     n_iters = inputs.shape[0] // big.ss.take
     if n_iters == 0:
         # below one steady-state iteration: delegate entirely so the
@@ -240,15 +244,14 @@ def stream_parallel_batched(comp: ir.Comp, batch, mesh: Mesh,
     over `sp_axis` — the 2-D composition (dp × sp) of frame batching
     and sequence parallelism on one mesh.
 
-    Stages must be stateless or declare `advance` (data-independent
-    state): those entry carries are frame-independent, so one set of
-    per-sp-shard carries serves every frame. Finite-`memory` stages
-    are refused here — their entry state depends on each frame's own
-    preceding items (per-frame warmup would need an sp halo exchange;
-    use the single-stream path per frame instead). Streams must divide
-    exactly: frames % dp == 0 and per-frame iterations must align to
-    sp x width — batched decode is a planned layout, not a ragged one
-    (pad upstream), unlike the single-stream path's host tail.
+    Same stage discipline as :func:`stream_parallel`: stateless,
+    `advance` (frame-independent analytic fast-forward), or finite
+    `memory` — whose entry state is seeded per (frame, shard) by a
+    warmup scan over that FRAME's own preceding items, host-side.
+    Streams must divide exactly: frames % dp == 0 and per-frame
+    iterations must align to sp x width — batched decode is a planned
+    layout, not a ragged one (pad upstream), unlike the single-stream
+    path's host tail.
     """
     n_dp = mesh.shape[dp_axis]
     n_sp = mesh.shape[sp_axis]
@@ -276,12 +279,20 @@ def stream_parallel_batched(comp: ir.Comp, batch, mesh: Mesh,
             f"({n_sp} x {big.width} x take {big.ss.take}); pad "
             f"upstream")
 
-    stages, advances, _warm = _stage_plan(comp, big,
-                                          allow_memory=False)
+    stages, advances, warm_iters = _stage_plan(comp, big)
+    carry_at = _entry_carry_fn(comp, big, stages, advances, warm_iters)
+    # per-(frame, shard) entry carries; without memory stages every
+    # frame's set is identical, but building B copies keeps ONE path
+    per_frame = [
+        jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[carry_at(d * per, batch[f]) for d in range(n_sp)])
+        for f in range(B)]
     carries = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs),
-        *[_fast_forward_carry(stages, big, advances, d * per)
-          for d in range(n_sp)])
+        lambda *xs: jnp.stack(xs), *per_frame)      # (B, n_sp, ...)
+    carries = jax.tree_util.tree_map(
+        lambda x: x.reshape((n_dp, B // n_dp, n_sp) + x.shape[2:]),
+        carries)
 
     steps = per // big.width
     scan = big.scan_steps()
@@ -291,16 +302,19 @@ def stream_parallel_batched(comp: ir.Comp, batch, mesh: Mesh,
     shaped = jnp.asarray(shaped)
 
     def shard_body(carry_stack, chunks):
-        # chunks: (1, B/dp, 1, steps, take, ...) local block
-        carry = jax.tree_util.tree_map(lambda x: x[0], carry_stack)
+        # chunks: (1, B/dp, 1, steps, take, ...) local block;
+        # carry leaves: (1, B/dp, 1, ...) — one carry per local frame
+        car_f = jax.tree_util.tree_map(lambda x: x[0, :, 0],
+                                       carry_stack)
 
-        def one_frame(fr):
-            _, ys = scan(carry, fr[0])
+        def one_frame(fr, car):
+            _, ys = scan(car, fr)
             return ys
 
-        return jax.vmap(one_frame)(chunks[0])[None, :, None]
+        ys = jax.vmap(one_frame)(chunks[0, :, 0], car_f)
+        return ys[None, :, None]
 
-    cspec = P(sp_axis)
+    cspec = P(dp_axis, None, sp_axis)
     dspec = P(dp_axis, None, sp_axis)
     run2 = jax.jit(shard_map(shard_body, mesh=mesh,
                              in_specs=(cspec, dspec),
