@@ -32,12 +32,31 @@ def _gen_expr(rng, depth, names):
     return f"(({a}) {op} ({b}))"
 
 
-def _gen_stmts(rng, depth, names, indent):
-    """Random statements mutating `acc`/locals; returns source lines."""
+def _gen_stmts(rng, depth, names, indent, arrs=()):
+    """Random statements mutating `acc`/locals; returns source lines.
+    `arrs` lists in-scope arr[16] int32 names for indexed reads/writes
+    (dynamic indices exercise gather/scatter staging)."""
     pad = "  " * indent
     lines = []
     for _ in range(int(rng.integers(1, 4))):
-        kind = rng.choice(["assign", "if", "for", "while", "local"])
+        kind = rng.choice(["assign", "if", "for", "while", "local",
+                           "arr", "aset"])
+        if kind == "arr" and depth > 0 and not arrs:
+            nm = f"v{int(rng.integers(0, 1000))}"
+            lines.append(f"{pad}var {nm} : arr[16] int32;")
+            arrs = arrs + (nm,)
+            continue
+        if kind in ("arr", "aset") and arrs:
+            a = rng.choice(arrs)
+            idx = f"((({_gen_expr(rng, 1, names)}) % 16 + 16) % 16)"
+            if kind == "aset":
+                lines.append(f"{pad}{a}[{idx}] := "
+                             f"{_gen_expr(rng, 1, names)};")
+            else:
+                lines.append(f"{pad}acc := acc + {a}[{idx}];")
+            continue
+        if kind in ("arr", "aset"):
+            kind = "assign"
         if kind == "local" and depth > 0:
             nm = f"t{int(rng.integers(0, 1000))}"
             lines.append(f"{pad}var {nm} : int32 := "
@@ -49,16 +68,17 @@ def _gen_stmts(rng, depth, names, indent):
             cond = f"({_gen_expr(rng, 1, names)}) > " \
                    f"{int(rng.integers(-10, 10))}"
             lines.append(f"{pad}if {cond} then {{")
-            lines += _gen_stmts(rng, depth - 1, names, indent + 1)
+            lines += _gen_stmts(rng, depth - 1, names, indent + 1, arrs)
             lines.append(f"{pad}}} else {{")
-            lines += _gen_stmts(rng, depth - 1, names, indent + 1)
+            lines += _gen_stmts(rng, depth - 1, names, indent + 1, arrs)
             lines.append(f"{pad}}};")
         elif kind == "for" and depth > 0:
             # mix small (unrolled) and large (fori-staged) trip counts
             n = int(rng.choice([3, 7, 30, 40]))
             v = f"i{int(rng.integers(0, 1000))}"
             lines.append(f"{pad}for {v} in [0, {n}] {{")
-            lines += _gen_stmts(rng, depth - 1, names + [v], indent + 1)
+            lines += _gen_stmts(rng, depth - 1, names + [v], indent + 1,
+                                arrs)
             lines.append(f"{pad}}};")
         elif kind == "while" and depth > 0:
             # bounded data-dependent loop: guard counter always local
@@ -66,7 +86,8 @@ def _gen_stmts(rng, depth, names, indent):
             lines.append(f"{pad}var {g} : int32 := "
                          f"(({_gen_expr(rng, 1, names)}) % 7 + 7) % 7;")
             lines.append(f"{pad}while ({g} > 0) {{")
-            body = _gen_stmts(rng, depth - 1, names + [g], indent + 1)
+            body = _gen_stmts(rng, depth - 1, names + [g], indent + 1,
+                              arrs)
             lines += body
             lines.append(f"{pad}  {g} := {g} - 1")
             lines.append(f"{pad}}};")
