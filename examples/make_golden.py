@@ -75,7 +75,20 @@ CASES = [
     # inferred AutoLUT (lutinfer): arr[8] bit and int8 funs with no
     # declared domains; replayed with --autolut (AUTOLUT_CASES)
     ("pack_bits", "bit", lambda: _bits(8 * 96, 118), "dbg"),
+    # the FLAGSHIP as a checked-in golden: an impaired 24 Mbps capture
+    # through the in-language receiver; replayed on the hybrid backend
+    # (HYBRID_CASES) — detection, CFO, SIGNAL parse, rate dispatch and
+    # decode all pinned by one file pair
+    ("wifi_rx", "complex16", lambda: _rx_capture(24, 60, 119), "bin"),
 ]
+
+
+def _rx_capture(mbps, n_bytes, seed):
+    # main() pins the CPU platform before any case builder runs
+    from ziria_tpu.phy.channel import impaired_capture
+
+    _psdu, xi = impaired_capture(mbps, n_bytes, seed, floor=0.02)
+    return xi
 
 # cases compiled under the fixed-point complex16 policy
 # (--fxp-complex16 on replay)
@@ -88,6 +101,10 @@ INTERP_CASES = {"wifi_tx_full"}
 # cases replayed with --autolut: the inferred-LUT rewrite must leave
 # the golden output untouched (flag invariance)
 AUTOLUT_CASES = {"pack_bits", "lut_map"}
+
+# cases replayed on the hybrid backend (dynamic control; heavy
+# do-blocks jit) — ground truth still comes from the interpreter
+HYBRID_CASES = {"wifi_rx"}
 
 
 def main() -> None:

@@ -5,11 +5,13 @@ Each example runs through the CLI jit backend against the committed
 input, and the output must match the committed ground truth (produced
 by the interpreter oracle via examples/make_golden.py) under the
 BlinkDiff-style comparator: exact for integer/bit streams, atol=1 for
-quantized complex. Exception: cases in make_golden.INTERP_CASES replay
-on the interpreter (whole-frame programs whose fully-unrolled jit
-graphs take minutes of XLA compile on CPU) — for those this test pins
-CLI file I/O + determinism only; their jit-vs-interp equality is
-carried by the per-block goldens that cover the same constructs."""
+quantized complex. Exceptions: cases in make_golden.INTERP_CASES
+replay on the interpreter (whole-frame programs whose fully-unrolled
+jit graphs take minutes of XLA compile on CPU) — for those this test
+pins CLI file I/O + determinism only — and cases in HYBRID_CASES
+(dynamic-control programs, e.g. the flagship receiver) replay on the
+hybrid backend, pinning interpreter-vs-hybrid equality through the
+committed files."""
 
 import os
 
@@ -35,10 +37,12 @@ def _generator_cases():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return ({name: mode for name, _ty, _mk, mode in mod.CASES},
-            mod.FXP_CASES, mod.INTERP_CASES, mod.AUTOLUT_CASES)
+            mod.FXP_CASES, mod.INTERP_CASES, mod.AUTOLUT_CASES,
+            mod.HYBRID_CASES)
 
 
-_MODES, _FXP_CASES, _INTERP_CASES, _AUTOLUT_CASES = _generator_cases()
+(_MODES, _FXP_CASES, _INTERP_CASES, _AUTOLUT_CASES,
+ _HYBRID_CASES) = _generator_cases()
 
 # quantized complex streams compare with atol=1; float LLR outputs
 # tolerate interp-f64 vs jit-f32 rounding; everything else exact
@@ -60,7 +64,8 @@ def test_golden(name, mode, atol, tmp_path):
         f"golden files missing for {name}; run examples/make_golden.py"
 
     outf = tmp_path / f"{name}.out"
-    backend = "interp" if name in _INTERP_CASES else "jit"
+    backend = ("interp" if name in _INTERP_CASES else
+               "hybrid" if name in _HYBRID_CASES else "jit")
     argv = [
         f"--src={src}", "--input=file", f"--input-file-name={infile}",
         f"--input-file-mode={mode}", "--output=file",

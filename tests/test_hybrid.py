@@ -22,17 +22,7 @@ SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
 
 def _capture(mbps, n_bytes, seed, cfo=0.002):
     from ziria_tpu.phy import channel
-    from ziria_tpu.phy.wifi import tx
-    rng = np.random.default_rng(seed)
-    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
-    frame = np.asarray(tx.encode_frame(psdu, mbps))
-    x = np.concatenate([
-        rng.normal(scale=0.02, size=(60, 2)).astype(np.float32),
-        np.asarray(channel.apply_cfo(jnp.asarray(frame), cfo)),
-        rng.normal(scale=0.02, size=(40, 2)).astype(np.float32)])
-    x = (x + rng.normal(scale=0.03, size=x.shape)).astype(np.float32)
-    xi = np.clip(np.round(x * 1024), -32768, 32767).astype(np.int16)
-    return psdu, xi
+    return channel.impaired_capture(mbps, n_bytes, seed, cfo=cfo)
 
 
 @pytest.mark.parametrize("mbps,n_bytes", [(6, 30), (24, 60), (54, 90)])

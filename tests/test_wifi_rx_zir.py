@@ -31,18 +31,10 @@ SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
 
 def _impaired_capture(mbps: int, n_bytes: int, seed: int,
                       cfo: float = 0.002):
-    """TX frame + delay/CFO/AWGN, quantized to the complex16 wire
-    format (int16 pairs) both receivers consume identically."""
-    rng = np.random.default_rng(seed)
-    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
-    frame = np.asarray(tx.encode_frame(psdu, mbps))
-    x = np.asarray(channel.apply_cfo(jnp.asarray(frame), cfo))
-    x = np.concatenate([
-        rng.normal(scale=0.02, size=(60, 2)).astype(np.float32), x,
-        rng.normal(scale=0.02, size=(40, 2)).astype(np.float32)])
-    x = (x + rng.normal(scale=0.03, size=x.shape)).astype(np.float32)
-    xi = np.clip(np.round(x * 1024), -32768, 32767).astype(np.int16)
-    return psdu, xi
+    """TX frame + CFO/AWGN, quantized to the complex16 wire format
+    (int16 pairs) both receivers consume identically — the shared
+    recipe in phy/channel.py (also used by the wifi_rx golden)."""
+    return channel.impaired_capture(mbps, n_bytes, seed, cfo=cfo)
 
 
 @pytest.mark.parametrize("mbps,n_bytes", [(6, 30), (9, 33), (12, 40),

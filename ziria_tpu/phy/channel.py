@@ -62,3 +62,31 @@ def multipath(samples, taps_pair) -> jnp.ndarray:
     re = conv(x[:, 0], t[:, 0]) - conv(x[:, 1], t[:, 1])
     im = conv(x[:, 0], t[:, 1]) + conv(x[:, 1], t[:, 0])
     return jnp.stack([re, im], axis=-1)
+
+
+def impaired_capture(mbps: int, n_bytes: int, seed: int,
+                     cfo: float = 0.002, pre: int = 60, post: int = 40,
+                     noise: float = 0.03, floor: float = 0.02,
+                     scale: float = 1024.0):
+    """A deterministic receiver test vector: one TX frame with CFO,
+    surrounded by noise, plus AWGN, quantized to the complex16 wire
+    format (int16 IQ pairs). Returns (psdu_bytes, samples).
+
+    The single source of truth for the capture recipe the receiver
+    tests AND the checked-in wifi_rx golden use — three copies of this
+    pipeline had already appeared before it was hoisted here.
+    """
+    import numpy as np
+
+    from ziria_tpu.phy.wifi import tx
+
+    rng = np.random.default_rng(seed)
+    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+    frame = np.asarray(tx.encode_frame(psdu, mbps))
+    x = np.concatenate([
+        rng.normal(scale=floor, size=(pre, 2)).astype(np.float32),
+        np.asarray(apply_cfo(jnp.asarray(frame), cfo)),
+        rng.normal(scale=floor, size=(post, 2)).astype(np.float32)])
+    x = (x + rng.normal(scale=noise, size=x.shape)).astype(np.float32)
+    xi = np.clip(np.round(x * scale), -32768, 32767).astype(np.int16)
+    return psdu, xi
