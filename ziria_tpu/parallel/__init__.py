@@ -1,10 +1,19 @@
+from ziria_tpu.parallel.autosplit import (AutoSplitError, auto_pipeline,
+                                          balanced_partition)
 from ziria_tpu.parallel.batch import data_parallel, frame_mesh, shard_batch
 from ziria_tpu.parallel.multihost import (build_mesh, init_multihost,
                                           mesh_info)
 from ziria_tpu.parallel.stages import PPLowered, lower_stage_parallel
+from ziria_tpu.parallel.streampar import (StreamParError, sliding_parallel,
+                                          stream_mesh, stream_parallel,
+                                          stream_parallel_batched)
 
 __all__ = [
+    "AutoSplitError",
     "PPLowered",
+    "StreamParError",
+    "auto_pipeline",
+    "balanced_partition",
     "build_mesh",
     "data_parallel",
     "frame_mesh",
@@ -12,4 +21,8 @@ __all__ = [
     "lower_stage_parallel",
     "mesh_info",
     "shard_batch",
+    "sliding_parallel",
+    "stream_mesh",
+    "stream_parallel",
+    "stream_parallel_batched",
 ]
