@@ -62,9 +62,17 @@ _INT_DTYPES = {"int8": np.int8, "int16": np.int16, "int32": np.int32,
 _CPLX = ("complex", "complex16", "complex32")
 
 
+_JNP = None
+
+
 def _jnp():
-    import jax.numpy as jnp
-    return jnp
+    # cached: this is called on nearly every evaluated operation, and
+    # the repeated sys.modules lookup showed up in interpreter profiles
+    global _JNP
+    if _JNP is None:
+        import jax.numpy as jnp
+        _JNP = jnp
+    return _JNP
 
 
 _NP_CONCRETE = (int, float, bool, complex, np.ndarray, np.generic)
