@@ -1,0 +1,75 @@
+"""Seeded computation-level fuzzing: random `repeat { ... }` bodies
+built from take/takes/emit/emits/do/static-for with stream-level
+state, compiled by the full parser->elab path and required to agree
+between the interpreter oracle and the fused jit backend (whose
+firing functions trace these very bodies). Complements the
+expression-level surface fuzzer."""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.frontend import compile_source
+from ziria_tpu.interp.interp import run
+
+N_CASES = 16
+
+
+def _gen_body(rng):
+    """One repeat-body: returns (lines, n_take). Always emits."""
+    lines = []
+    vals = []                      # scalar value names in scope
+    arrs = []                      # (name, len) array values
+    n_take = 0
+    for _ in range(int(rng.integers(1, 5))):
+        kind = rng.choice(["take", "takes", "do", "emit_for"])
+        if kind == "take":
+            v = f"x{len(vals)}"
+            lines.append(f"  {v} <- take;")
+            vals.append(v)
+            n_take += 1
+        elif kind == "takes":
+            k = int(rng.choice([2, 4, 8]))
+            a = f"v{len(arrs)}"
+            lines.append(f"  ({a} : arr[{k}] int32) <- takes {k};")
+            arrs.append((a, k))
+            n_take += k
+        elif kind == "do" and (vals or arrs):
+            src = vals[-1] if vals and (not arrs or rng.random() < 0.5) \
+                else f"{arrs[-1][0]}[{int(rng.integers(0, arrs[-1][1]))}]"
+            lines.append(f"  do {{ s := s + {src} }};")
+        elif kind == "emit_for" and arrs:
+            a, k = arrs[int(rng.integers(0, len(arrs)))]
+            lines.append(f"  for i in [0, {k}] {{ emit {a}[i] * 2 + s }};")
+    # guaranteed stream input + emission
+    if n_take == 0:
+        lines.insert(0, "  x0 <- take;")
+        vals.append("x0")
+        n_take = 1
+    src = vals[-1] if vals else f"{arrs[-1][0]}[0]"
+    lines.append(f"  emit {src} + s;")
+    lines.append("  do { s := s + 1 }")
+    return lines, n_take
+
+
+def _gen_program(seed):
+    rng = np.random.default_rng(seed)
+    body, n_take = _gen_body(rng)
+    src = ("let comp main = read[int32] >>> {\n"
+           "  var s : int32 := 0;\n"
+           "  repeat {\n" + "\n".join("  " + ln for ln in body) +
+           "\n  }\n} >>> write[int32]\n")
+    # whole iterations only: the jit tail policy drops partial firings
+    iters = int(rng.integers(3, 30))
+    xs = rng.integers(-100, 100, iters * n_take).astype(np.int32)
+    return src, xs
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_comp_backend_agreement(seed):
+    src, xs = _gen_program(seed)
+    prog = compile_source(src)
+    want = np.asarray(run(prog.comp, list(xs)).out_array())
+    got = np.asarray(run_jit(prog.comp, xs))
+    np.testing.assert_array_equal(
+        got, want, err_msg=f"seed {seed}\n{src}")

@@ -18,8 +18,15 @@ import numpy as np
 
 
 def _jnp():
-    import jax.numpy as jnp
-    return jnp
+    # cached module lookup (hot on every ext call; see frontend/eval)
+    global _JNP_MOD
+    if _JNP_MOD is None:
+        import jax.numpy as jnp
+        _JNP_MOD = jnp
+    return _JNP_MOD
+
+
+_JNP_MOD = None
 
 
 def _xp(args):
