@@ -1,0 +1,75 @@
+#!/bin/bash
+# Round-3 TPU evidence watcher.
+#
+# The axon TPU backend hangs for hours at a time (BENCH_NOTES.md
+# availability log). This loop probes it every 10 minutes with a
+# hard-kill timeout; whenever a probe succeeds it immediately runs the
+# evidence chain:
+#   bench.py                  -> BENCH_LIVE.json   (headline RX sps/chip)
+#   tools/calibrate_vect.py   -> VECT_CALIB.json   (vectorizer utility model)
+#   tools/hybrid_tpu_check.py -> HYBRID_TPU.json   (hybrid RX on-chip)
+# After a full success it keeps running and re-harvests every 3 h so
+# later bench.py improvements are re-measured within the same round.
+#
+# Mutual exclusion: all TPU access must be serialized (two clients both
+# hang). `touch /tmp/tpu_busy` pauses the watcher for manual TPU work;
+# `touch /tmp/stop_tpu_watcher` stops it. The watcher takes /tmp/tpu_busy
+# itself while harvesting.
+set -u
+cd /root/repo
+LOG=/root/repo/BENCH_LIVE.log
+DEADLINE=$(( $(date +%s) + 42000 ))   # ~11.5 h
+echo "[watcher] start chain-v3 $(date -u +%H:%M:%S)" >> "$LOG"
+while [ "$(date +%s)" -lt "$DEADLINE" ] && [ ! -e /tmp/stop_tpu_watcher ]; do
+  if [ -e /tmp/tpu_busy ]; then
+    sleep 60
+    continue
+  fi
+  if timeout -k 10 180 python -c "
+import jax
+d = jax.devices()[0]
+assert d.platform != 'cpu', d.platform
+print('probe ok:', d.platform, d.device_kind)
+" >> "$LOG" 2>&1; then
+    touch /tmp/tpu_busy
+    echo "[watcher] probe ok $(date -u +%H:%M:%S); running bench" >> "$LOG"
+    timeout -k 15 1500 env TPU_BUSY_HELD=1 python bench.py > /root/repo/BENCH_LIVE.json.tmp 2>> "$LOG"
+    rc=$?
+    echo "[watcher] bench rc=$rc" >> "$LOG"
+    if [ $rc -eq 0 ] && python -c "
+import json,sys
+j = json.load(open('/root/repo/BENCH_LIVE.json.tmp'))
+sys.exit(0 if j.get('platform') not in (None,'cpu') else 1)
+" 2>> "$LOG"; then
+      mv /root/repo/BENCH_LIVE.json.tmp /root/repo/BENCH_LIVE.json
+      echo "[watcher] bench SUCCESS $(date -u +%H:%M:%S)" >> "$LOG"
+      if [ ! -s /root/repo/VECT_CALIB.json ]; then
+        touch /tmp/tpu_busy   # refresh: bench.py treats >35min-old flags as leaked
+        timeout -k 15 1800 python tools/calibrate_vect.py \
+          > /root/repo/VECT_CALIB.json.tmp 2>> "$LOG" \
+          && mv /root/repo/VECT_CALIB.json.tmp /root/repo/VECT_CALIB.json \
+          && echo "[watcher] calib ok" >> "$LOG" \
+          || echo "[watcher] calib failed" >> "$LOG"
+      fi
+      if [ ! -s /root/repo/HYBRID_TPU.json ]; then
+        touch /tmp/tpu_busy
+        timeout -k 15 1800 python tools/hybrid_tpu_check.py \
+          > /root/repo/HYBRID_TPU.json.tmp 2>> "$LOG" \
+          && mv /root/repo/HYBRID_TPU.json.tmp /root/repo/HYBRID_TPU.json \
+          && echo "[watcher] hybrid-on-tpu ok" >> "$LOG" \
+          || echo "[watcher] hybrid-on-tpu failed" >> "$LOG"
+      fi
+      echo "[watcher] CHAIN DONE $(date -u +%H:%M:%S); sleeping 3h" >> "$LOG"
+      rm -f /tmp/tpu_busy
+      sleep 10800
+      continue
+    fi
+    pkill -9 -f "bench.py --tpu-" 2>/dev/null   # child AND probe modes
+    rm -f /tmp/tpu_busy
+  else
+    echo "[watcher] probe failed/hung $(date -u +%H:%M:%S)" >> "$LOG"
+  fi
+  sleep 600
+done
+rm -f /tmp/tpu_busy
+echo "[watcher] exit (deadline/stop) $(date -u +%H:%M:%S)" >> "$LOG"
