@@ -1,0 +1,256 @@
+"""Chunked state machines (backend/chunked.py): stream-control loops
+compiled to single device calls — the TPU counterpart of the reference
+compiling per-sample take/emit loops into C state machines (SURVEY.md
+§2.1 CgComp, §3.2 tick/process). The contract everywhere: output
+bit-identical to the interpreter oracle, including EOF mid-loop.
+
+(`Result.consumed` MAY legitimately exceed the oracle's when a chunked
+loop reads ahead through a pipe — the same read-ahead the reference's
+thread-separator queues perform; outputs and termination kind must
+still match.)
+"""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend import hybrid as H
+from ziria_tpu.backend.chunked import _ChunkLoop, wrap_loops
+from ziria_tpu.core import ir
+from ziria_tpu.frontend import compile_source
+from ziria_tpu.interp.interp import run
+
+
+def _chunk_nodes(comp):
+    out = []
+
+    def walk(c):
+        if isinstance(c, _ChunkLoop):
+            out.append(c)
+            walk(c.orig)
+        for attr in ("first", "rest", "body", "then", "els", "up",
+                     "down"):
+            ch = getattr(c, attr, None)
+            if isinstance(ch, ir.Comp):
+                walk(ch)
+
+    walk(comp)
+    return out
+
+
+def _assert_match(src, xs, min_chunks=1, check_consumed=True):
+    prog = compile_source(src)
+    want = run(prog.comp, list(xs))
+    hyb = H.hybridize(prog.comp)
+    assert len(_chunk_nodes(hyb)) >= min_chunks
+    got = run(hyb, list(xs))
+    np.testing.assert_array_equal(np.asarray(want.out_array()),
+                                  np.asarray(got.out_array()))
+    assert want.terminated_by == got.terminated_by
+    if check_consumed:
+        assert want.consumed == got.consumed
+    # second run through the same wrapped object: caches must not
+    # leak state across executions
+    got2 = run(hyb, list(xs))
+    np.testing.assert_array_equal(np.asarray(want.out_array()),
+                                  np.asarray(got2.out_array()))
+    return hyb
+
+
+TAKE_BRANCH_SRC = """
+let comp main = read[int32] >>> {
+  var acc : arr[512] int32;
+  var s : int32 := 0;
+  times 256 {
+    x <- take;
+    do {
+      if (x % 2 == 0) then { s := s + x } else { s := s + 1 };
+      acc[s % 512] := x
+    }
+  };
+  times 256 { emit acc[(s + 255) % 512]; do { s := s + 3 } }
+} >>> write[int32]
+"""
+
+
+def test_for_take_branch_and_emit_loop():
+    # data-dependent branch in a take loop + a separate emit loop, both
+    # chunk-compiled; top-level (no pipe buffering => consumed matches)
+    _assert_match(TAKE_BRANCH_SRC, np.arange(300, dtype=np.int32),
+                  min_chunks=2)
+
+
+def test_for_eof_midway():
+    # input ends inside the take loop: outputs/termination match the
+    # oracle exactly (interpreter tail path handles the final sliver)
+    prog = compile_source(TAKE_BRANCH_SRC)
+    hyb = H.hybridize(prog.comp)
+    for n in (0, 1, 79, 255):
+        xs = np.arange(n, dtype=np.int32)
+        want = run(prog.comp, list(xs))
+        got = run(hyb, list(xs))
+        np.testing.assert_array_equal(np.asarray(want.out_array()),
+                                      np.asarray(got.out_array()))
+        assert want.terminated_by == got.terminated_by == "eof"
+
+
+WHILE_SRC = """
+let comp main = read[int32] >>> {
+  var s : int32 := 0;
+  var armed : bool := false;
+  while (!armed) {
+    x <- take;
+    do {
+      s := s + x * x - (s / 7);
+      if (s % 1000 > 900) then { armed := true }
+    }
+  };
+  emit s;
+  (w : arr[20] int32) <- takes 20;
+  do { for k in [0, 20] { s := s + w[k] } };
+  emit s
+} >>> write[int32]
+"""
+
+
+def test_while_detect_loop_pushback_visible():
+    # the while over-pulls a window; the takes AFTER the loop must see
+    # the pushed-back items — outputs prove the stream stayed intact
+    prog = compile_source(WHILE_SRC)
+    hyb = H.hybridize(prog.comp)
+    assert len(_chunk_nodes(hyb)) == 1
+    xs = (np.arange(1000, dtype=np.int32) * 7919) % 97
+    want = run(prog.comp, list(xs))
+    got = run(hyb, list(xs))
+    np.testing.assert_array_equal(np.asarray(want.out_array()),
+                                  np.asarray(got.out_array()))
+    assert want.terminated_by == got.terminated_by == "computer"
+
+
+def test_while_eof_before_arming():
+    prog = compile_source(WHILE_SRC)
+    hyb = H.hybridize(prog.comp)
+    for n in (0, 3, 7):
+        xs = np.zeros(n, np.int32)      # never arms
+        want = run(prog.comp, list(xs))
+        got = run(hyb, list(xs))
+        np.testing.assert_array_equal(np.asarray(want.out_array()),
+                                      np.asarray(got.out_array()))
+        assert want.consumed == got.consumed == n
+        assert want.terminated_by == got.terminated_by == "eof"
+
+
+def test_loop_in_repeat_framed_stream():
+    # a chunked loop under `repeat`: frame boundaries must survive the
+    # window over-pull (pushback feeds the next repeat iteration)
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (h : arr[4] int32) <- takes 4;
+      var s : int32 := 0;
+      times 60 {
+        x <- take;
+        do { if (x > h[0]) then { s := s + x } else { s := s - x } }
+      };
+      emit s
+    } >>> write[int32]
+    """
+    xs = (np.arange(64 * 5, dtype=np.int32) * 13) % 101
+    _assert_match(src, xs, min_chunks=1)
+
+
+def test_nested_loop_with_lead_buffer():
+    # the wifi symbol-gather shape: inner per-sample loop choosing
+    # between a preloaded buffer and the live stream, under an outer
+    # symbol loop — both staged into ONE machine
+    src = """
+    let comp main = read[int32] >>> {
+      var lead : arr[48] int32;
+      var g : int32 := 0;
+      var acc : int32 := 0;
+      do { for i in [0, 48] { lead[i] := 1000 + i } };
+      times 8 {
+        times 40 {
+          var v : int32 := 0;
+          if (g < 48) then { do { v := lead[g] } }
+          else { x <- take; do { v := x * 2 } };
+          do { g := g + 1; acc := acc + v }
+        };
+        emit acc
+      }
+    } >>> write[int32]
+    """
+    xs = np.arange(400, dtype=np.int32)
+    _assert_match(src, xs, min_chunks=1, check_consumed=False)
+
+
+def test_effectful_loop_not_wrapped():
+    src = """
+    let comp main = read[int32] >>> {
+      var s : int32 := 0;
+      times 300 { x <- take; do { s := s + x; println s } };
+      emit s
+    } >>> write[int32]
+    """
+    prog = compile_source(src)
+    hyb = H.hybridize(prog.comp)
+    assert len(_chunk_nodes(hyb)) == 0
+
+
+def test_tiny_loop_falls_back_to_interp():
+    # below MIN_ITEMS_FOR the wrapper delegates (gate is at runtime —
+    # the node exists but the run matches and stays cheap)
+    src = """
+    let comp main = read[int32] >>> {
+      var s : int32 := 0;
+      times 4 { x <- take; do { s := s + x } };
+      emit s
+    } >>> write[int32]
+    """
+    _assert_match(src, np.arange(10, dtype=np.int32), min_chunks=0)
+
+
+def test_wrap_decisions_dumped():
+    lines = []
+    H.hybridize(compile_source(TAKE_BRANCH_SRC).comp, dump=lines.append)
+    assert any("chunked For" in l for l in lines)
+
+
+def test_value_select_keeps_big_buffers_unswapped():
+    # the staged-if value-select peephole (frontend/eval.py): both arms
+    # write ONE element of a >4096-entry buffer through the same index;
+    # jit result must equal the interpreter exactly
+    from ziria_tpu.backend.execute import run_jit
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (v : arr[16] int32) <- takes 16;
+      var dep : arr[8192] int32;
+      var s : int32 := 0;
+      do {
+        for t in [0, 512] {
+          var keep : int32 := 1;
+          if (t % 4 == 3) then { keep := 0 };
+          if (keep == 1) then { dep[t] := v[t % 16] * t; s := s + 1 }
+          else { dep[t] := 0 - 7 }
+        }
+      };
+      emit dep[100] + dep[103] + s
+    } >>> write[int32]
+    """
+    prog = compile_source(src)
+    xs = (np.arange(64, dtype=np.int32) * 31) % 257
+    want = run(prog.comp, list(xs)).out_array()
+    got = np.asarray(run_jit(prog.comp, xs))
+    np.testing.assert_array_equal(np.asarray(want), got)
+
+
+def test_pipe_value_survives_bulk_pull_eof():
+    # code-review r3: Source.pull_block used to swallow UpstreamDone
+    # and its value; the re-pull of the exhausted upstream generator
+    # then produced UpstreamDone(None) — a Pipe whose downstream hits
+    # EOF via a bulk `takes` lost the upstream computer's return value
+    import ziria_tpu as z
+
+    up = z.seq(z.emits(np.arange(3, dtype=np.int32), 3), z.ret(42))
+    down = z.let("w", z.takes(5), z.emit1(lambda env: env["w"][0]))
+    r = run(ir.Pipe(up, down), [])
+    assert r.value == 42
+    assert r.terminated_by == "computer"

@@ -21,7 +21,9 @@ LOG=/root/repo/BENCH_LIVE.log
 DEADLINE=$(( $(date +%s) + 42000 ))   # ~11.5 h
 echo "[watcher] start chain-v3 $(date -u +%H:%M:%S)" >> "$LOG"
 while [ "$(date +%s)" -lt "$DEADLINE" ] && [ ! -e /tmp/stop_tpu_watcher ]; do
-  if [ -e /tmp/tpu_busy ]; then
+  # take the flag atomically BEFORE touching the backend: the probe
+  # itself is a TPU client, and a concurrent bench.py would hang both
+  if ! ( set -C; echo "watcher pid $$" > /tmp/tpu_busy ) 2>/dev/null; then
     sleep 60
     continue
   fi
@@ -31,7 +33,6 @@ d = jax.devices()[0]
 assert d.platform != 'cpu', d.platform
 print('probe ok:', d.platform, d.device_kind)
 " >> "$LOG" 2>&1; then
-    touch /tmp/tpu_busy
     echo "[watcher] probe ok $(date -u +%H:%M:%S); running bench" >> "$LOG"
     timeout -k 15 1500 env TPU_BUSY_HELD=1 python bench.py > /root/repo/BENCH_LIVE.json.tmp 2>> "$LOG"
     rc=$?

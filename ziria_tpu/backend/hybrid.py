@@ -171,6 +171,7 @@ class _JitDo:
     def __init__(self, closure):
         self.closure = closure
         self._fns: Dict[Tuple, Any] = {}
+        self._ok: set = set()      # structs that completed a real call
         self._broken = False
         # syntactic read/write sets slice the env: only touched names
         # cross the host<->device boundary per firing
@@ -208,9 +209,17 @@ class _JitDo:
             self._fns[struct] = fn
         try:
             ret, refs = fn(tuple(vals))
+            self._ok.add(struct)
         except Exception:
-            # un-jittable content (non-arrayable values, dynamic takes
-            # count downstream, ...) — permanent fallback, oracle
+            if struct in self._ok:
+                # this block has compiled and run before: the failure is
+                # a runtime execution error (device OOM, backend flake),
+                # not un-jittable structure. Silently demoting to the
+                # interpreter would hide it and erase the hybrid win
+                # with no diagnostic (ADVICE r2) — surface it.
+                raise
+            # first-call staging failure (non-arrayable values, dynamic
+            # takes count downstream, ...) — permanent fallback, oracle
             # semantics preserved
             self._broken = True
             return self.closure(env)
@@ -234,12 +243,17 @@ class _JitDo:
 
 
 def hybridize(comp: ir.Comp, min_weight: int = MIN_JIT_WEIGHT,
-              dump=None) -> ir.Comp:
-    """Rewrite heavy do-blocks into `_JitDo` wrappers; everything else
-    is untouched. Running the result on the interpreter gives hybrid
-    execution. `dump`, if given, receives one line per do-block with
-    its decision (the --ddump-hybrid flag)."""
+              dump=None, chunk_loops: bool = True) -> ir.Comp:
+    """Rewrite heavy do-blocks into `_JitDo` wrappers and stream-I/O
+    control loops into chunked state machines (backend/chunked.py);
+    everything else is untouched. Running the result on the interpreter
+    gives hybrid execution. `dump`, if given, receives one line per
+    decision (the --ddump-hybrid flag)."""
     import dataclasses
+
+    if chunk_loops:
+        from ziria_tpu.backend.chunked import wrap_loops
+        comp = wrap_loops(comp, dump=dump)
 
     def walk(c: ir.Comp) -> ir.Comp:
         if isinstance(c, ir.Return) and callable(c.expr):

@@ -327,6 +327,11 @@ class Elaborator:
                                  fxp=ctx.fxp_complex16)
             return v
 
+        # expose the expression AST (+ Ctx for fun-body recursion) so
+        # comp-level analyses (backend/chunked.py bounds, effects and
+        # free-variable checks) can see through the closure
+        run.z_expr = e
+        run.z_ctx = ctx
         return run
 
     def stmts_closure(self, stmts: Tuple[A.Stmt, ...], ee: ElabEnv) -> Any:
@@ -479,7 +484,16 @@ class Elaborator:
         if isinstance(c, A.CUntil):
             body = self.elab_comp(c.body, ee)
             cond = self.closure(c.c, ee)
-            neg = (lambda env, _c=cond: not bool(ir.eval_expr(_c, env)))
+
+            def neg(env, _c=cond):
+                v = ir.eval_expr(_c, env)
+                if E._is_traced(v):          # stageable under jit tracing
+                    import jax.numpy as jnp
+                    return jnp.logical_not(v)
+                return not bool(v)
+
+            neg.z_expr = A.EUn(op="!", e=c.c, loc=c.loc)
+            neg.z_ctx = self.ctx
             return ir.Bind(body, None, ir.While(neg, body))
         if isinstance(c, A.CCall):
             return self._elab_call(c, ee)

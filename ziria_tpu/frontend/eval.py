@@ -1254,11 +1254,114 @@ def _staged_while(st: A.SWhile, scope: Scope, ctx: Ctx):
     return None
 
 
+def _value_select_plans(st: A.SIf, scope: Scope):
+    """Big-buffer writes mergeable at VALUE level instead of buffer
+    level. The default staged-if merge selects whole cell values; for
+    `if c then { dep[i] := e1 } else { dep[i] := e2 }` over a 131072-
+    element frame buffer that is a full-buffer select per execution —
+    inside a staged loop, gigabytes of memory traffic (measured: it WAS
+    the wifi receiver's entire per-symbol cost). When every write to a
+    big cell is a single top-level element assignment through the SAME
+    index expression (and the cell is otherwise untouched by the arms),
+    the merge can instead select the scalar and store once.
+
+    Returns [(name, lval_ast)] of rewritable cells.
+    """
+    def elem_writes(arm):
+        out: Dict[str, List[A.SAssign]] = {}
+        for s in arm:
+            if isinstance(s, A.SAssign) and isinstance(s.lval, A.EIdx) \
+                    and isinstance(s.lval.arr, A.EVar):
+                out.setdefault(s.lval.arr.name, []).append(s)
+        return out
+
+    then_w, else_w = elem_writes(st.then), elem_writes(st.els)
+    plans = []
+    for name in sorted(set(then_w) | set(else_w)):
+        cell = scope.find(name)
+        if cell is None or not cell.mutable:
+            continue
+        try:
+            if np.size(cell.value) <= 4096:
+                continue
+        except Exception:       # pragma: no cover - exotic cell values
+            continue
+        wt = then_w.get(name, [])
+        we = else_w.get(name, [])
+        if len(wt) > 1 or len(we) > 1:
+            continue
+        lvs = [s.lval for s in wt + we]
+        if len(lvs) == 2 and lvs[0] != lvs[1]:
+            continue            # different indices: keep buffer merge
+        site_stmts = set(map(id, wt + we))
+        # the cell must appear NOWHERE else in the arms: not read (its
+        # pre-branch slot value stands in for the untaken write), not
+        # written from nested control flow
+        ok = True
+        for arm in (st.then, st.els):
+            for s in arm:
+                if id(s) in site_stmts:
+                    reads: set = set()
+                    _expr_reads(s.e, reads)
+                    _expr_reads(s.lval.i, reads)
+                    if name in reads:
+                        ok = False
+                else:
+                    names: set = set()
+                    _stmt_reads((s,), names)
+                    _stmt_writes((s,), names)
+                    if name in names:
+                        ok = False
+        if not ok:
+            continue
+        # deferring the store needs the index unchanged by the arms
+        idx_reads: set = set()
+        _expr_reads(lvs[0].i, idx_reads)
+        arm_writes: set = set()
+        _stmt_writes(st.then, arm_writes)
+        _stmt_writes(st.els, arm_writes)
+        if idx_reads & arm_writes:
+            continue
+        plans.append((name, lvs[0]))
+    return plans
+
+
 def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):
     """Dynamic-condition `if`: run both arms on the live scope, snapshot
     mutable cells around each, and merge assigned cells with jnp.where —
-    the staging of imperative control flow into select ops."""
+    the staging of imperative control flow into select ops. Big-buffer
+    single-site writes are first rewritten to scalar value-selects
+    (`_value_select_plans`) so the merge never copies frame buffers."""
     jnp = _jnp()
+
+    plans = _value_select_plans(st, scope)
+    if plans:
+        import dataclasses
+        tmps = {}
+        for k, (name, lval) in enumerate(plans):
+            t = f"__selv{k}_{name}"
+            tmps[name] = t
+            scope.declare(t, eval_expr(lval, scope, ctx), None,
+                          mutable=True)
+
+        def rw(stmts):
+            out = []
+            for s in stmts:
+                if isinstance(s, A.SAssign) and isinstance(s.lval, A.EIdx) \
+                        and isinstance(s.lval.arr, A.EVar) \
+                        and s.lval.arr.name in tmps:
+                    out.append(dataclasses.replace(
+                        s, lval=A.EVar(name=tmps[s.lval.arr.name])))
+                else:
+                    out.append(s)
+            return tuple(out)
+
+        st2 = dataclasses.replace(st, then=rw(st.then), els=rw(st.els))
+        _staged_if(cond, st2, scope, ctx)
+        for name, lval in plans:
+            _assign_lval(lval, scope.lookup(tmps[name]), scope, ctx)
+            del scope.cells[tmps[name]]
+        return None
     cells = scope.mutable_cells()
     before = [c.value for c in cells]
 

@@ -234,7 +234,15 @@ def _viterbi_soft(llrs, npairs, nbits):
         out = jnp.zeros(arr.shape[0] // 2, jnp.uint8)
         return out.at[:nbits].set(bits.astype(jnp.uint8))
     arr = np.asarray(llrs, np.float32)
-    bits = np_viterbi_decode(arr[: 2 * npairs], n_bits=nbits)
+    # host path: prefer the native C decoder (ctypes, the same brick
+    # the perf baseline uses) — ~100x the numpy ACS loop on long
+    # frames; fall back to numpy where no toolchain built it
+    from ziria_tpu.runtime.native_lib import load, viterbi_decode_native
+    if load() is not None and npairs > 64:
+        bits = viterbi_decode_native(
+            arr[: 2 * npairs].reshape(-1, 2))[:nbits].astype(np.uint8)
+    else:
+        bits = np_viterbi_decode(arr[: 2 * npairs], n_bits=nbits)
     out = np.zeros(arr.shape[0] // 2, np.uint8)
     out[:nbits] = bits
     return out

@@ -39,14 +39,81 @@ class UpstreamDone(Exception):
         self.token = token
 
 
+class Source:
+    """Pull-source with pushback: the handle every `take` in a stream
+    level shares. Chunked state machines (backend/chunked.py) bulk-pull
+    a window of items, let the compiled step consume what it can, and
+    `push_back` the unconsumed tail — which MUST remain visible to
+    whatever takes next in the same stream level, hence a shared object
+    rather than a bare closure. The first `UpstreamDone` is LATCHED
+    (value + token) and re-raised on every later pull once pushed-back
+    items drain: a re-pull of an exhausted generator would raise a
+    fresh StopIteration carrying None, silently dropping the upstream
+    computer's return value the original exception carried."""
+
+    __slots__ = ("_pull", "_back", "_pending")
+
+    def __init__(self, pull: Callable[[], Any]):
+        self._pull = pull
+        self._back: List[Any] = []
+        self._pending: Optional[UpstreamDone] = None
+
+    def __call__(self):
+        if self._back:
+            return self._back.pop()
+        if self._pending is not None:
+            raise UpstreamDone(self._pending.value, self._pending.token)
+        try:
+            return self._pull()
+        except UpstreamDone as e:
+            self._pending = e
+            raise
+
+    def push_back(self, items) -> None:
+        """Re-enqueue `items` so the FIRST of them is the next pulled."""
+        self._back.extend(reversed(list(items)))
+
+    def pending(self) -> int:
+        """Items pulled from upstream but pushed back (not yet re-taken)."""
+        return len(self._back)
+
+    def pull_block(self, n: int):
+        """Pull up to `n` items; returns (items, eof). `eof` means the
+        underlying stream raised UpstreamDone before `n` items arrived
+        (the exception is latched and re-raises, with its original
+        value/token, on the next pull past the buffered items)."""
+        items: List[Any] = []
+        while len(items) < n and self._back:
+            items.append(self._back.pop())
+        if self._pending is not None:
+            return items, True
+        try:
+            while len(items) < n:
+                items.append(self._pull())
+        except UpstreamDone as e:
+            self._pending = e
+            return items, True
+        return items, False
+
+
 def _run(comp: ir.Comp, env: Env, source: Callable[[], Any], xp=np):
     """Generator: yields emitted items; returns the control value."""
+    rg = getattr(comp, "run_gen", None)
+    if rg is not None:
+        # extension nodes (backend/chunked._ChunkLoop) drive themselves
+        return (yield from rg(env, source, xp))
+
     if isinstance(comp, ir.Take):
         return source()
         yield  # pragma: no cover — makes this a generator
 
     if isinstance(comp, ir.Takes):
-        items = [source() for _ in range(comp.n)]
+        if isinstance(source, Source):
+            items, _eof = source.pull_block(comp.n)
+            if len(items) < comp.n:
+                source()  # re-raises the underlying UpstreamDone
+        else:
+            items = [source() for _ in range(comp.n)]
         return xp.stack([xp.asarray(x) for x in items])
         yield  # pragma: no cover
 
@@ -117,14 +184,20 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any], xp=np):
         # takes nor emits would loop forever without ever yielding control.
         takes_seen = [0]
 
-        def counting_source():
+        def counting_pull():
             takes_seen[0] += 1
             return source()
 
+        # one Source for the whole repeat: pushback from a chunked loop
+        # in one iteration stays visible to the next iteration's takes
+        body_source = Source(counting_pull)
+
         while True:
-            before = takes_seen[0]
+            # net consumption = pulls minus still-pushed-back items, so a
+            # bulk-pull-then-push-back cycle doesn't fake progress
+            before = takes_seen[0] - body_source.pending()
             emitted = False
-            it = _run(comp.body, env, counting_source, xp)
+            it = _run(comp.body, env, body_source, xp)
             try:
                 while True:
                     item = next(it)
@@ -132,7 +205,7 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any], xp=np):
                     yield item
             except StopIteration:
                 pass
-            if not emitted and takes_seen[0] == before:
+            if not emitted and takes_seen[0] - body_source.pending() == before:
                 raise ValueError(
                     "repeat body made no stream progress in an iteration "
                     f"(body {comp.body.label()}): diverges")
@@ -164,11 +237,13 @@ def _run(comp: ir.Comp, env: Env, source: Callable[[], Any], xp=np):
         up_gen = _run(comp.up, env, source, xp)
         token = object()  # identifies THIS pipe's upstream termination
 
-        def down_source():
+        def down_pull():
             try:
                 return next(up_gen)
             except StopIteration as e:
                 raise UpstreamDone(e.value, token=token) from None
+
+        down_source = Source(down_pull)
 
         # `>>>` terminates as soon as either side does, with that side's
         # value: downstream termination is a plain generator return;
@@ -213,7 +288,7 @@ def run(comp: ir.Comp, inputs: Iterable[Any] = (),
     it = iter(inputs)
     consumed = [0]
 
-    def source():
+    def pull():
         try:
             x = next(it)
         except StopIteration:
@@ -221,14 +296,18 @@ def run(comp: ir.Comp, inputs: Iterable[Any] = (),
         consumed[0] += 1
         return x
 
+    source = Source(pull)
     outputs: List[Any] = []
     gen = _run(comp, env or Env(), source)
     try:
         while True:
             if max_out is not None and len(outputs) >= max_out:
-                return Result(outputs, None, consumed[0], "limit")
+                return Result(outputs, None,
+                              consumed[0] - source.pending(), "limit")
             outputs.append(next(gen))
     except StopIteration as e:
-        return Result(outputs, e.value, consumed[0], "computer")
+        return Result(outputs, e.value,
+                      consumed[0] - source.pending(), "computer")
     except UpstreamDone as e:
-        return Result(outputs, e.value, consumed[0], "eof")
+        return Result(outputs, e.value,
+                      consumed[0] - source.pending(), "eof")

@@ -169,14 +169,7 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
     """
     n_dev = mesh.shape[axis]
     big = lower(comp, width=width)
-    stages, advances, warm_iters = _stage_plan(comp, big)
-    stateful = any(jax.tree_util.tree_leaves(c0)
-                   for c0 in big.init_carry)
     inputs = np.asarray(inputs)
-    _carry_at = _entry_carry_fn(comp, big, stages, advances, warm_iters)
-
-    def carry_at(iters_done: int):
-        return _carry_at(iters_done, inputs)
     n_iters = inputs.shape[0] // big.ss.take
     if n_iters == 0:
         # below one steady-state iteration: delegate entirely so the
@@ -187,10 +180,21 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
     # each device gets `per` steady-state iterations, grouped into
     # bulk steps of `width` iterations = big.take items; when the
     # planned width exceeds a device's share, re-plan at the share so
-    # short streams still shard instead of falling to the tail path
+    # short streams still shard instead of falling to the tail path.
+    # The stage plan and entry-carry closure are built AFTER the
+    # re-plan: today ss.reps/init_carry are width-independent, but
+    # deriving them from the final lowering removes the silent
+    # assumption (ADVICE r2)
     share = n_iters // n_dev
     if 0 < share < big.width:
         big = lower(comp, width=share)
+    stages, advances, warm_iters = _stage_plan(comp, big)
+    stateful = any(jax.tree_util.tree_leaves(c0)
+                   for c0 in big.init_carry)
+    _carry_at = _entry_carry_fn(comp, big, stages, advances, warm_iters)
+
+    def carry_at(iters_done: int):
+        return _carry_at(iters_done, inputs)
     per = share // big.width * big.width
     outs = []
     if per:
@@ -211,10 +215,14 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
             _, ys = scan(carry, chunks)
             return ys
 
+        # out_specs uses bare P(axis): unmentioned trailing dims are
+        # unsharded, and the OUTPUT rank may differ from the input rank
+        # (pairs in -> scalar bits out; ADVICE r2 reproduced the
+        # failure with the input-rank spec)
         spec = P(axis, *([None] * (bulk.ndim - 1)))
         run = jax.jit(shard_map(
             shard_body, mesh=mesh, in_specs=(P(axis), spec),
-            out_specs=spec))
+            out_specs=P(axis)))
         with mesh:
             ys = np.asarray(run(carries, bulk))
         outs.append(ys.reshape((n_dev * steps * big.emit,)
