@@ -1,0 +1,107 @@
+"""End-to-end statistical validation: BER waterfalls under AWGN
+(VERDICT r2 #8). The golden pairs prove bit-exactness on one capture;
+this proves the *statistics* of the demod+decode chain behave like an
+802.11a receiver should: BER falls monotonically with SNR, reaches
+zero at documented operating points, denser constellations pay more at
+equal SNR, and soft-decision decoding shows real coding gain over the
+theoretical UNCODED channel-bit error rate.
+
+Setup is the standard BER-sim isolation: perfect timing/CFO (frames
+from tx.encode_frame + AWGN only), rate forced — measuring the
+equalize/demap/deinterleave/Viterbi/descramble chain, not packet
+detection (detection robustness is exercised by the golden captures'
+impairments).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ziria_tpu.phy import channel
+from ziria_tpu.phy.wifi import rx, tx
+from ziria_tpu.phy.wifi.params import RATES, n_symbols
+from ziria_tpu.utils.bits import bytes_to_bits
+
+N_FRAMES = 16
+N_BYTES = 100
+
+
+def _ber_at(mbps: int, snr_db: float, seed: int) -> float:
+    rate = RATES[mbps]
+    n_sym = n_symbols(N_BYTES, rate)
+    rng = np.random.default_rng(seed)
+    psdus = rng.integers(0, 256, (N_FRAMES, N_BYTES)).astype(np.uint8)
+    frames = jnp.stack([tx.encode_frame(p, mbps) for p in psdus])
+    key = jax.random.PRNGKey(seed)
+    noisy = jax.vmap(
+        lambda k, f: channel.awgn(k, f, snr_db))(
+            jax.random.split(key, N_FRAMES), frames)
+    got, _ = rx.decode_data_batch(noisy, rate, n_sym, 8 * N_BYTES)
+    want = np.stack([np.asarray(bytes_to_bits(p)) for p in psdus])
+    return float(np.mean(np.asarray(got) != want))
+
+
+def _q(x):
+    from math import erfc, sqrt
+    return 0.5 * erfc(x / sqrt(2.0))
+
+
+def _uncoded_ber_theory(mbps: int, snr_db: float) -> float:
+    """Theoretical uncoded channel-bit error rate on a data subcarrier.
+
+    SNR here is total-signal/noise over the 64-sample symbol; energy
+    rides on 52 of 64 subcarriers, so per-subcarrier Es/N0 = SNR*64/52.
+    Gray-mapped M-QAM nearest-neighbor approximations (standard texts):
+    BPSK Q(sqrt(2g)); QPSK Q(sqrt(g)) per bit; 16-QAM (3/4)Q(sqrt(g/5));
+    64-QAM (7/12)Q(sqrt(g/21)) with g = Es/N0.
+    """
+    g = (10.0 ** (snr_db / 10.0)) * 64.0 / 52.0
+    n_bpsc = RATES[mbps].n_bpsc
+    if n_bpsc == 1:
+        return _q(np.sqrt(2.0 * g))
+    if n_bpsc == 2:
+        return _q(np.sqrt(g))
+    if n_bpsc == 4:
+        return 0.75 * _q(np.sqrt(g / 5.0))
+    return (7.0 / 12.0) * _q(np.sqrt(g / 21.0))
+
+
+@pytest.mark.parametrize("mbps,snrs,clean_snr", [
+    (6, [-4.0, -1.0, 2.0], 6.0),
+    (24, [2.0, 5.0, 8.0], 14.0),
+    (54, [10.0, 13.0, 16.0], 24.0),
+])
+def test_waterfall_monotone_and_clean_at_operating_snr(mbps, snrs,
+                                                       clean_snr):
+    bers = [_ber_at(mbps, s, seed=7) for s in snrs]
+    # waterfall: strictly falling across the transition region (allow
+    # equality only when both are already tiny)
+    for lo, hi in zip(bers[1:], bers[:-1]):
+        assert lo < hi or hi < 1e-3, (mbps, bers)
+    # the lowest point must sit in the transition (noise is real)
+    assert bers[0] > 1e-3, (mbps, bers)
+    # error-free at the documented operating SNR
+    assert _ber_at(mbps, clean_snr, seed=8) == 0.0, mbps
+
+
+def test_denser_constellations_pay_more_at_equal_snr():
+    snr = 8.0
+    b6, b24, b54 = (_ber_at(m, snr, seed=9) for m in (6, 24, 54))
+    assert b6 <= b24 <= b54, (b6, b24, b54)
+    assert b54 > 1e-2        # 64-QAM 3/4 is far from clean at 8 dB
+    assert b6 == 0.0         # BPSK 1/2 is comfortably clean at 8 dB
+
+
+@pytest.mark.parametrize("mbps,snr", [(6, 3.0), (24, 11.0), (54, 20.0)])
+def test_soft_decoding_beats_uncoded_theory(mbps, snr):
+    # above the code's cutoff region the K=7 soft-decision decode must
+    # show real coding gain: measured coded BER well under the
+    # theoretical UNCODED channel-bit error rate at the same SNR.
+    # (Below cutoff, convolutional codes legitimately do worse than
+    # uncoded — the anchors sit where uncoded BER ~ 1e-2..5e-3,
+    # measured crossover: 6 Mbps ~2.5 dB, 24 ~10 dB, 54 ~18.5 dB.)
+    coded = _ber_at(mbps, snr, seed=10)
+    uncoded = _uncoded_ber_theory(mbps, snr)
+    assert uncoded > 1e-3, (mbps, snr, uncoded)   # in-transition check
+    assert coded < 0.5 * uncoded, (mbps, snr, coded, uncoded)

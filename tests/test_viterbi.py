@@ -84,3 +84,30 @@ def test_native_c_viterbi_matches_jax():
     got_jax = np.asarray(viterbi.viterbi_decode(llr))
     assert_stream_eq(got_c, got_jax)
     assert_stream_eq(got_c, bits)
+
+
+def test_native_simd_acs_bit_exact_with_scalar():
+    # the AVX2 ACS (runtime/native/viterbi.c, the SORA-SSE-class
+    # baseline kernel) must match the portable scalar path bit-for-bit
+    # on random soft values — same op order, same tie-breaks, same
+    # per-step renorm (BASELINE.md r3)
+    import ctypes
+
+    from ziria_tpu.runtime.native_lib import load, viterbi_decode_native
+    lib = load()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    if not hasattr(lib, "ziria_viterbi_decode_scalar"):
+        pytest.skip("old native build without the scalar hook")
+    rng = np.random.default_rng(42)
+    for T in (64, 1000, 8208):
+        llrs = rng.normal(size=(T, 2)).astype(np.float32)
+        fast = viterbi_decode_native(llrs)
+        ref = np.zeros(T, np.uint8)
+        lib.ziria_viterbi_decode_scalar(
+            llrs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(T),
+            ref.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        np.testing.assert_array_equal(fast, ref, err_msg=f"T={T}")
+        oracle = np.asarray(viterbi.viterbi_decode(llrs.reshape(-1)))
+        np.testing.assert_array_equal(fast, oracle, err_msg=f"T={T}")

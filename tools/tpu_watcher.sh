@@ -60,6 +60,14 @@ sys.exit(0 if j.get('platform') not in (None,'cpu') else 1)
           && echo "[watcher] hybrid-on-tpu ok" >> "$LOG" \
           || echo "[watcher] hybrid-on-tpu failed" >> "$LOG"
       fi
+      if [ ! -s /root/repo/VITERBI_SWEEP.json ]; then
+        touch /tmp/tpu_busy
+        timeout -k 15 1500 python tools/viterbi_batch_sweep.py \
+          > /root/repo/VITERBI_SWEEP.json.tmp 2>> "$LOG" \
+          && mv /root/repo/VITERBI_SWEEP.json.tmp /root/repo/VITERBI_SWEEP.json \
+          && echo "[watcher] viterbi sweep ok" >> "$LOG" \
+          || echo "[watcher] viterbi sweep failed" >> "$LOG"
+      fi
       echo "[watcher] CHAIN DONE $(date -u +%H:%M:%S); sleeping 3h" >> "$LOG"
       rm -f /tmp/tpu_busy
       sleep 10800

@@ -94,9 +94,19 @@ class PPLowered:
 
     ``run(xs)``: xs (M, take, *item) -> (M, emit, *out_item); M macro
     steps of input, same M of output (fill/drain handled internally).
+
+    ``run_carry(xs)``: (ys, fused_carry) — additionally returns the
+    segments' exit carries flattened to the FUSED single-device
+    lowering's per-stage tuple (``lower(pipe(*segments))``'s carry
+    order), so a sub-macro-chunk input remainder can continue on the
+    single-device path with exact state (the reference's queues had no
+    length restriction; SURVEY.md §2.2 TS queues). Fill/drain bubbles
+    never step segment carries (two-sided masking), so the exit
+    carries equal the sequential run's after the same items.
     """
 
     run: Callable
+    run_carry: Callable
     take: int
     emit: int
     n_stages: int
@@ -155,14 +165,16 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
         lo = lows[k]
 
         def br(operand):
-            carries, slots, x_in, m = operand
+            carries, slots, x_in, m, m_real = operand
             my_in = x_in if k == 0 else slots[k - 1]
 
-            # Input m reaches segment k at macro step m+k, so steps < k
-            # carry fill bubbles (zeros): a stateful segment must NOT
-            # step its carry on them or it diverges from the fused >>>
-            # lowering. (Trailing drain bubbles also corrupt carries,
-            # but only after every real output has been produced.)
+            # Input m reaches segment k at macro step m+k, so the live
+            # window for segment k is k <= m < m_real + k; outside it
+            # the chunk is a fill/drain bubble (zeros) and a stateful
+            # segment must NOT step its carry on it — fill bubbles
+            # would diverge from the fused >>> lowering, and drain
+            # bubbles would corrupt the exit carries run_carry hands
+            # to the single-device remainder path.
             def live(cx):
                 c, out = lo.step(cx[0], cx[1])
                 return c, out
@@ -171,7 +183,8 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
                 return cx[0], zeros_like_struct(
                     chunk_structs[k + 1] if k < K - 1 else out_struct)
 
-            c, out = lax.cond(m >= k, live, bubble, (carries[k], my_in))
+            alive = jnp.logical_and(m >= k, m < m_real + k)
+            c, out = lax.cond(alive, live, bubble, (carries[k], my_in))
             carries = tuple(c if j == k else carries[j] for j in range(K))
             if k < K - 1:
                 slots = tuple(out if j == k else slots[j]
@@ -185,15 +198,26 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
 
     branches = [make_branch(k) for k in range(K)]
 
+    def _mask_psum(leaf, keep):
+        """Replicate `leaf` from the device where `keep` holds (exact:
+        the other devices contribute zeros of the same dtype)."""
+        if leaf.dtype == jnp.bool_:
+            z = jnp.where(keep, leaf.astype(jnp.int32), 0)
+            return lax.psum(z, axis).astype(jnp.bool_)
+        return lax.psum(jnp.where(keep, leaf, jnp.zeros_like(leaf)), axis)
+
     def spmd_one(xs):
-        """Per-device program; xs replicated (M+K-1, take, *item)."""
+        """Per-device program; xs replicated (M+K-1, take, *item).
+        Returns (ys, carries) with carries replicated (each segment's
+        exit state gathered from its owning device)."""
         idx = lax.axis_index(axis)
+        m_real = xs.shape[0] - (K - 1)      # static: real macro steps
 
         def macro(state, xm):
             x, m = xm
             carries, slots = state
             carries, slots, final = lax.switch(
-                idx, branches, (carries, slots, x, m))
+                idx, branches, (carries, slots, x, m, m_real))
             if K > 1:
                 slots = lax.ppermute(slots, axis, perm)
             # replicate the tail device's output to everyone (exact in
@@ -204,21 +228,30 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
             return (carries, slots), final
 
         steps = jnp.arange(xs.shape[0], dtype=jnp.int32)
-        (_, _), ys = lax.scan(macro, (init_carries, init_slots), (xs, steps))
-        return ys
+        (carries, _), ys = lax.scan(
+            macro, (init_carries, init_slots), (xs, steps))
+        carries = tuple(
+            jax.tree_util.tree_map(
+                lambda lf: _mask_psum(lf, idx == k), carries[k])
+            for k in range(K))
+        return ys, carries
 
     if batch_axis is None:
         spec_in = P()
-        spec_out = P(*([None] * (len(out_struct.shape) + 1)))
+        carry_specs = jax.tree_util.tree_map(lambda _: P(), init_carries)
+        spec_out = (P(*([None] * (len(out_struct.shape) + 1))),
+                    carry_specs)
         spmd = spmd_one
     else:
         # each dp row holds its local shard of streams; vmap runs the
-        # pipeline per stream (the pp collectives batch under vmap)
+        # pipeline per stream (the pp collectives batch under vmap).
+        # Exit carries are not exposed on the batched path (each stream
+        # would need its own remainder continuation; pad upstream).
         spec_in = P(batch_axis)
         spec_out = P(batch_axis, *([None] * (len(out_struct.shape) + 1)))
 
         def spmd(xs_b):
-            return jax.vmap(spmd_one)(xs_b)
+            return jax.vmap(spmd_one)(xs_b)[0]
 
     mapped = shard_map(spmd, mesh=mesh, in_specs=spec_in,
                        out_specs=spec_out, check_vma=False)
@@ -226,17 +259,32 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
 
     t_axis = 0 if batch_axis is None else 1
 
-    def run(xs):
+    def _call(xs):
         xs = jnp.asarray(xs)
         if K > 1:  # trailing dummies flush the pipeline
             pad_shape = list(xs.shape)
             pad_shape[t_axis] = K - 1
             xs = jnp.concatenate(
                 [xs, jnp.zeros(pad_shape, xs.dtype)], axis=t_axis)
-        ys = jitted(xs)
+        out = jitted(xs)
+        ys, carries = out if batch_axis is None else (out, None)
         if K > 1:
             ys = ys[K - 1:] if batch_axis is None else ys[:, K - 1:]
-        return ys
+        return ys, carries
 
-    return PPLowered(run=run, take=lows[0].take, emit=lows[-1].emit,
-                     n_stages=K, labels=tuple(s.label() for s in segs))
+    def run(xs):
+        return _call(xs)[0]
+
+    def run_carry(xs):
+        """(ys, carry) — carry is a run_jit_carry-compatible dict whose
+        "stages" tuple follows lower(pipe(*segments))'s stage order."""
+        from itertools import chain
+        ys, carries = _call(xs)
+        if carries is None:
+            raise LowerError("run_carry is unavailable on the batched "
+                             "(dp x pp) path")
+        return ys, {"stages": tuple(chain.from_iterable(carries))}
+
+    return PPLowered(run=run, run_carry=run_carry, take=lows[0].take,
+                     emit=lows[-1].emit, n_stages=K,
+                     labels=tuple(s.label() for s in segs))

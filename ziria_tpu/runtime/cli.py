@@ -366,6 +366,13 @@ def main(argv=None) -> int:
     return 0
 
 
+def _seq_of(comp):
+    """ParPipe pipeline -> plain Pipe of the same segments (the fused
+    single-device equivalent, sharing carry structure stage-for-stage)."""
+    from ziria_tpu.core import ir as _ir
+    return _ir.pipe(*_ir.par_segments(comp))
+
+
 def _run_auto_pp(comp, xs, args, t0):
     """--pp=N: compiler-decided stage placement across N devices (the
     reference's auto-pipelining pass, minus the hand-written |>>>|)."""
@@ -393,15 +400,34 @@ def _run_auto_pp(comp, xs, args, t0):
             in_item=jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype))
     except (LowerError, StreamParError) as e:
         raise SystemExit(f"--pp={args.pp}: {e}")
-    if xs.shape[0] % pp.take:
-        raise SystemExit(
-            f"--pp={args.pp}: stream of {xs.shape[0]} items must be a "
-            f"multiple of the pipeline's macro chunk ({pp.take}); pad "
-            f"the input")
     m = xs.shape[0] // pp.take
-    ys = np.asarray(pp.run(xs.reshape((m, pp.take) + xs.shape[1:])))
-    return (ys.reshape((m * pp.emit,) + ys.shape[2:]),
-            time.perf_counter() - t0)
+    r = xs.shape[0] - m * pp.take
+    if r == 0:
+        ys = np.asarray(pp.run(xs.reshape((m, pp.take) + xs.shape[1:])))
+        return (ys.reshape((m * pp.emit,) + ys.shape[2:]),
+                time.perf_counter() - t0)
+    # remainder path: the reference's queues had no length restriction
+    # (SURVEY.md §2.2 TS queues). Run the whole macro chunks through
+    # the pipeline, then continue the tail on the fused single-device
+    # path seeded with the segments' exit carries — exact vs run_jit
+    # for any length.
+    from ziria_tpu.backend.execute import run_jit_carry
+    seq = _seq_of(comp)
+    outs = []
+    carry = None
+    if m:
+        ys, carry = pp.run_carry(
+            xs[: m * pp.take].reshape((m, pp.take) + xs.shape[1:]))
+        ys = np.asarray(ys)
+        outs.append(ys.reshape((m * pp.emit,) + ys.shape[2:]))
+    tail, _ = run_jit_carry(seq, xs[m * pp.take:], carry=carry,
+                            width=args.width)
+    tail = np.asarray(tail)
+    if tail.shape[0]:
+        outs.append(tail)
+    ys = (np.concatenate(outs, axis=0) if outs
+          else np.empty((0,) + xs.shape[1:], xs.dtype))
+    return ys, time.perf_counter() - t0
 
 
 def _run_backend(comp, xs, args, t0):

@@ -1,11 +1,24 @@
 /* Soft-decision Viterbi decoder, K=7 (g0=133o, g1=171o), 64 states.
  *
- * Native CPU reference/baseline implementation — the role the SORA SSE
- * Viterbi brick plays in the reference system (SURVEY.md §2.2): a
- * C-speed decoder the accelerator path is benchmarked against, and the
- * host-side fallback decoder for the runtime. Loaded via ctypes
- * (ziria_tpu/runtime/native.py). Plain portable C; the compiler
- * auto-vectorizes the 64-wide ACS inner loops.
+ * Native CPU baseline implementation — the role the SORA SSE Viterbi
+ * brick plays in the reference system (SURVEY.md §2.2): a SIMD-parallel
+ * C decoder the accelerator path is benchmarked against, and the
+ * host-side decoder for the runtime. Loaded via ctypes
+ * (ziria_tpu/runtime/native_lib.py).
+ *
+ * Two ACS paths, REQUIRED to be bit-exact with each other (same
+ * operation order — mul then add, no FMA contraction; same tie-break
+ * d = (c1 > c0); same per-step renormalisation):
+ *
+ * - AVX2 (the default on this box): the 64-state ACS runs as 8 float
+ *   vectors per trellis step. Butterfly layout: children t and t+32
+ *   share predecessor pair (2(t&31), 2(t&31)+1), so the predecessor
+ *   metrics are one even/odd deinterleave of the metric array and the
+ *   branch metrics are contiguous loads of per-child constant tables.
+ *   Decisions pack to one uint64 per step (movemask), which also cuts
+ *   traceback memory 8x vs byte-per-state. This is the same
+ *   within-frame SIMD parallelisation strategy as SORA's SSE brick.
+ * - Portable scalar fallback (non-AVX2 builds).
  *
  * State convention matches ziria_tpu/ops/viterbi.py: state = the 6 most
  * recent input bits, newest in bit 5; edge into state t consumes input
@@ -21,8 +34,11 @@
 
 static int g_init = 0;
 static int pred[N_STATES][2];
-static float out_a[N_STATES][2];
-static float out_b[N_STATES][2];
+/* branch output tables in child-state order: out_x[d][t] */
+static float out_a0[N_STATES] __attribute__((aligned(32)));
+static float out_b0[N_STATES] __attribute__((aligned(32)));
+static float out_a1[N_STATES] __attribute__((aligned(32)));
+static float out_b1[N_STATES] __attribute__((aligned(32)));
 
 static const int G0[7] = {1, 0, 1, 1, 0, 1, 1}; /* 133 octal */
 static const int G1[7] = {1, 1, 1, 1, 0, 0, 1}; /* 171 octal */
@@ -42,16 +58,107 @@ static void init_tables(void) {
                 a ^= G0[i] & w[i];
                 bb ^= G1[i] & w[i];
             }
-            out_a[t][d] = 2.0f * a - 1.0f;
-            out_b[t][d] = 2.0f * bb - 1.0f;
+            if (d == 0) {
+                out_a0[t] = 2.0f * a - 1.0f;
+                out_b0[t] = 2.0f * bb - 1.0f;
+            } else {
+                out_a1[t] = 2.0f * a - 1.0f;
+                out_b1[t] = 2.0f * bb - 1.0f;
+            }
         }
     }
     g_init = 1;
 }
 
-/* llrs: T pairs (A,B); out: T decoded bits. Returns 0 on success. */
-int ziria_viterbi_decode(const float *llrs, int64_t T, uint8_t *out) {
-    init_tables();
+#if defined(__AVX2__)
+#include <immintrin.h>
+
+/* m[2j] / m[2j+1] for one block of 8 consecutive j from m[16..]:
+ * v0 = m[base..base+7], v1 = m[base+8..base+15]. */
+static inline __m256 deint_even(__m256 v0, __m256 v1) {
+    __m256 s = _mm256_shuffle_ps(v0, v1, _MM_SHUFFLE(2, 0, 2, 0));
+    return _mm256_permutevar8x32_ps(
+        s, _mm256_setr_epi32(0, 1, 4, 5, 2, 3, 6, 7));
+}
+
+static inline __m256 deint_odd(__m256 v0, __m256 v1) {
+    __m256 s = _mm256_shuffle_ps(v0, v1, _MM_SHUFFLE(3, 1, 3, 1));
+    return _mm256_permutevar8x32_ps(
+        s, _mm256_setr_epi32(0, 1, 4, 5, 2, 3, 6, 7));
+}
+
+static int decode_avx2(const float *llrs, int64_t T, uint8_t *out) {
+    uint64_t *dec = (uint64_t *)malloc((size_t)T * sizeof(uint64_t));
+    if (!dec) return -1;
+
+    float m[N_STATES] __attribute__((aligned(32)));
+    float nm[N_STATES] __attribute__((aligned(32)));
+    for (int s = 0; s < N_STATES; s++) m[s] = NEG_INF;
+    m[0] = 0.0f;
+
+    for (int64_t k = 0; k < T; k++) {
+        const __m256 la = _mm256_set1_ps(llrs[2 * k]);
+        const __m256 lb = _mm256_set1_ps(llrs[2 * k + 1]);
+        uint64_t word = 0;
+        __m256 vbest = _mm256_set1_ps(NEG_INF);
+        for (int jb = 0; jb < 4; jb++) {
+            const int j = 8 * jb;            /* j .. j+7 */
+            __m256 v0 = _mm256_load_ps(m + 2 * j);
+            __m256 v1 = _mm256_load_ps(m + 2 * j + 8);
+            __m256 me = deint_even(v0, v1);  /* m[2j]   */
+            __m256 mo = deint_odd(v0, v1);   /* m[2j+1] */
+            /* children t = j..j+7 (lower half) and t+32 (upper) */
+            for (int half = 0; half < 2; half++) {
+                const int t = j + 32 * half;
+                /* scalar order: (m + a*la) + b*lb — mul then adds */
+                __m256 c0 = _mm256_add_ps(
+                    _mm256_add_ps(
+                        me, _mm256_mul_ps(_mm256_load_ps(out_a0 + t),
+                                          la)),
+                    _mm256_mul_ps(_mm256_load_ps(out_b0 + t), lb));
+                __m256 c1 = _mm256_add_ps(
+                    _mm256_add_ps(
+                        mo, _mm256_mul_ps(_mm256_load_ps(out_a1 + t),
+                                          la)),
+                    _mm256_mul_ps(_mm256_load_ps(out_b1 + t), lb));
+                __m256 gt = _mm256_cmp_ps(c1, c0, _CMP_GT_OQ);
+                __m256 c = _mm256_blendv_ps(c0, c1, gt);
+                _mm256_store_ps(nm + t, c);
+                vbest = _mm256_max_ps(vbest, c);
+                word |= (uint64_t)(uint32_t)_mm256_movemask_ps(gt)
+                        << t;
+            }
+        }
+        dec[k] = word;
+        /* renormalise exactly like the scalar path: subtract the step
+         * maximum from every metric, every step */
+        __m128 lo = _mm256_castps256_ps128(vbest);
+        __m128 hi = _mm256_extractf128_ps(vbest, 1);
+        __m128 mx = _mm_max_ps(lo, hi);
+        mx = _mm_max_ps(mx, _mm_movehl_ps(mx, mx));
+        mx = _mm_max_ss(mx, _mm_shuffle_ps(mx, mx, 1));
+        __m256 vb = _mm256_set1_ps(_mm_cvtss_f32(mx));
+        for (int t = 0; t < N_STATES; t += 8)
+            _mm256_store_ps(
+                m + t, _mm256_sub_ps(_mm256_load_ps(nm + t), vb));
+    }
+
+    int state = 0;
+    float best = NEG_INF;
+    for (int t = 0; t < N_STATES; t++)
+        if (m[t] > best) { best = m[t]; state = t; }
+
+    for (int64_t k = T - 1; k >= 0; k--) {
+        out[k] = (uint8_t)(state >> 5);
+        int d = (int)((dec[k] >> state) & 1u);
+        state = pred[state][d];
+    }
+    free(dec);
+    return 0;
+}
+#endif /* __AVX2__ */
+
+static int decode_scalar(const float *llrs, int64_t T, uint8_t *out) {
     float m[N_STATES], nm[N_STATES];
     uint8_t *dec = (uint8_t *)malloc((size_t)T * N_STATES);
     if (!dec) return -1;
@@ -63,8 +170,8 @@ int ziria_viterbi_decode(const float *llrs, int64_t T, uint8_t *out) {
         float best = NEG_INF;
         uint8_t *dk = dec + k * N_STATES;
         for (int t = 0; t < N_STATES; t++) {
-            float c0 = m[pred[t][0]] + out_a[t][0] * la + out_b[t][0] * lb;
-            float c1 = m[pred[t][1]] + out_a[t][1] * la + out_b[t][1] * lb;
+            float c0 = m[pred[t][0]] + out_a0[t] * la + out_b0[t] * lb;
+            float c1 = m[pred[t][1]] + out_a1[t] * la + out_b1[t] * lb;
             int d = c1 > c0;
             float c = d ? c1 : c0;
             dk[t] = (uint8_t)d;
@@ -85,4 +192,22 @@ int ziria_viterbi_decode(const float *llrs, int64_t T, uint8_t *out) {
     }
     free(dec);
     return 0;
+}
+
+/* llrs: T pairs (A,B); out: T decoded bits. Returns 0 on success. */
+int ziria_viterbi_decode(const float *llrs, int64_t T, uint8_t *out) {
+    init_tables();
+#if defined(__AVX2__)
+    return decode_avx2(llrs, T, out);
+#else
+    return decode_scalar(llrs, T, out);
+#endif
+}
+
+/* test hook: run the portable path regardless of build ISA, so the
+ * SIMD path can be asserted bit-exact against it */
+int ziria_viterbi_decode_scalar(const float *llrs, int64_t T,
+                                uint8_t *out) {
+    init_tables();
+    return decode_scalar(llrs, T, out);
 }
