@@ -77,6 +77,7 @@ sys.exit(0 if j.get('platform') not in (None,'cpu') else 1)
     rm -f /tmp/tpu_busy
   else
     echo "[watcher] probe failed/hung $(date -u +%H:%M:%S)" >> "$LOG"
+    rm -f /tmp/tpu_busy     # release the flag taken before the probe
   fi
   sleep 600
 done
