@@ -1,0 +1,319 @@
+"""Lane-vector lowering of statement for-loops (frontend/eval.py
+`_vectorized_for`) — the reference vectorizer's widening applied to
+statement loops: eligible bodies run as one vector pass (gathers,
+per-lane selects, scatters, induction closed forms) instead of a
+lax.fori_loop of scalar ops. The contract is BIT-exactness with both
+the unvectorized staging (ZIRIA_NO_VECTOR_LOOPS=1) and the
+interpreter oracle — including sequential float-accumulation rounding.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.frontend import compile_source
+from ziria_tpu.frontend import eval as E
+from ziria_tpu.interp.interp import run
+
+
+def _both(src, xs):
+    prog = compile_source(src)
+    want = run(prog.comp, list(xs)).out_array()
+    got = np.asarray(run_jit(prog.comp, xs))
+    np.testing.assert_array_equal(np.asarray(want), got)
+    return got
+
+
+def _engaged(src, xs, expect: bool):
+    hits = []
+    orig = E._vectorized_for
+
+    def spy(start, count, st, scope, ctx):
+        r = orig(start, count, st, scope, ctx)
+        hits.append(r)
+        return r
+
+    E._vectorized_for = spy
+    try:
+        _both(src, xs)
+    finally:
+        E._vectorized_for = orig
+    assert any(hits) == expect, hits
+
+
+def test_gather_scatter_loop_vectorizes():
+    # deinterleave shape: out[k] := in[f(k)] with a non-affine READ
+    # index (gather) and an affine write index
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (v : arr[96] int32) <- takes 96;
+      var out : arr[96] int32;
+      do {
+        for k in [0, 96] {
+          out[k] := v[(96 / 16) * (k % 16) + k / 16] * 3
+        }
+      };
+      emits out[0, 96]
+    } >>> write[int32]
+    """
+    _engaged(src, (np.arange(192, dtype=np.int32) * 7) % 89, True)
+
+
+def test_multi_site_strided_scatter():
+    # demap shape: several affine sites with one stride, distinct
+    # offsets, plus a data-dependent per-lane select
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (v : arr[48] int32) <- takes 48;
+      var llr : arr[144] int32;
+      do {
+        for d in [0, 48] {
+          var t : int32 := v[d];
+          if (t % 2 == 0) then { t := t * 3 } else { t := 0 - t };
+          llr[3 * d] := t;
+          llr[3 * d + 1] := t + 1;
+          llr[3 * d + 2] := v[47 - d]
+        }
+      };
+      emits llr[0, 144]
+    } >>> write[int32]
+    """
+    _engaged(src, (np.arange(96, dtype=np.int32) * 31) % 257, True)
+
+
+def test_float_induction_rounds_sequentially():
+    # ph := ph + eps accumulated in double: the vector pass must
+    # reproduce SEQUENTIAL rounding exactly (closed form differs in
+    # ulps and would diverge from the oracle)
+    src = """
+    let comp main = read[int32] >>> repeat {
+      x <- take;
+      var acc : arr[64] double;
+      var ph : double := 0.1;
+      do {
+        for k in [0, 64] {
+          acc[k] := ph * x;
+          ph := ph + 0.3333333333
+        }
+      };
+      emit int32(acc[63] * 1000.0);
+      emit int32(ph * 1000.0)
+    } >>> write[int32]
+    """
+    _engaged(src, np.arange(1, 5, dtype=np.int32), True)
+
+
+def test_conditional_scatter_one_armed():
+    # rotate-loop shape: one-armed if guarding an affine write
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (v : arr[80] int32) <- takes 80;
+      var sym : arr[64] int32;
+      do {
+        for k in [0, 80] {
+          if (k >= 16) then { sym[k - 16] := v[k] * 2 + k }
+        }
+      };
+      emits sym[0, 64]
+    } >>> write[int32]
+    """
+    _engaged(src, (np.arange(160, dtype=np.int32) * 13) % 101, True)
+
+
+def test_reduction_stays_fori():
+    # spr := spr + f(k): loop-carried non-induction — must NOT engage
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (v : arr[32] int32) <- takes 32;
+      var s : int32 := 0;
+      do { for k in [0, 32] { s := s + v[k] * k } };
+      emit s
+    } >>> write[int32]
+    """
+    _engaged(src, (np.arange(64, dtype=np.int32) * 3) % 47, False)
+
+
+def test_read_write_same_array_stays_fori():
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (v : arr[32] int32) <- takes 32;
+      var a : arr[32] int32;
+      do {
+        for k in [0, 32] { a[k] := v[k] };
+        for k in [0, 32] {
+          a[(k * 7) % 32] := a[(k * 5) % 32] + 1
+        }
+      };
+      emits a[0, 32]
+    } >>> write[int32]
+    """
+    # second loop reads AND writes `a`; also indices are non-affine —
+    # correctness over speed
+    _both(src, (np.arange(64, dtype=np.int32) * 3) % 47)
+
+
+def test_colliding_sites_stay_fori():
+    # two sites with the same stride and SAME offset mod stride could
+    # collide across lanes — must stay sequential
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (v : arr[32] int32) <- takes 32;
+      var a : arr[80] int32;
+      do {
+        for k in [0, 32] {
+          a[2 * k] := v[k];
+          a[2 * k + 2] := v[k] * 5
+        }
+      };
+      emits a[0, 80]
+    } >>> write[int32]
+    """
+    _engaged(src, (np.arange(64, dtype=np.int32) * 3) % 47, False)
+
+
+def test_kill_switch_env_var():
+    code = textwrap.dedent("""
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from ziria_tpu.backend.execute import run_jit
+        from ziria_tpu.frontend import compile_source
+        from ziria_tpu.frontend import eval as E
+        src = '''
+        let comp main = read[int32] >>> repeat {
+          (v : arr[96] int32) <- takes 96;
+          var out : arr[96] int32;
+          do { for k in [0, 96] { out[k] := v[95 - k] } };
+          emits out[0, 96]
+        } >>> write[int32]
+        '''
+        hits = []
+        orig = E._vectorized_for
+        def spy(*a):
+            r = orig(*a)
+            hits.append(r)
+            return r
+        E._vectorized_for = spy
+        xs = np.arange(96, dtype=np.int32)
+        run_jit(compile_source(src).comp, xs)
+        assert not any(hits), hits
+        print("disabled ok")
+    """)
+    env = dict(os.environ, ZIRIA_NO_VECTOR_LOOPS="1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "disabled ok" in r.stdout
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_vector_loops_match_unvectorized(seed):
+    # random eligible-ish bodies: the vector pass (when it engages)
+    # must equal the interpreter exactly; ineligible shapes must fall
+    # back silently
+    rng = np.random.default_rng(5000 + seed)
+    n = int(rng.choice([24, 48, 96]))
+    stride = int(rng.choice([1, 2, 3]))
+    off = int(rng.integers(0, stride)) if stride > 1 else 0
+    mul = int(rng.integers(1, 7))
+    th = int(rng.integers(0, n))
+    src = f"""
+    let comp main = read[int32] >>> repeat {{
+      (v : arr[{n}] int32) <- takes {n};
+      var out : arr[{stride * n}] int32;
+      var ph : int32 := {int(rng.integers(-5, 5))};
+      do {{
+        for k in [0, {n}] {{
+          var t : int32 := v[k] * {mul} + ph;
+          if (k >= {th}) then {{ t := t - v[{n - 1} - k] }}
+          else {{ t := t + 7 }};
+          out[{stride} * k + {off}] := t;
+          ph := ph + {int(rng.integers(1, 4))}
+        }}
+      }};
+      emits out[0, {stride * n}];
+      emit ph
+    }} >>> write[int32]
+    """
+    xs = rng.integers(-1000, 1000, size=2 * n).astype(np.int32)
+    _both(src, xs)
+
+
+def test_arm_local_shadow_does_not_leak():
+    # code review r3 #1: a local declared inside an if-arm must not
+    # make a later top-level write to a SAME-NAMED outer scalar look
+    # local — that write is a non-induction outer write (ineligible)
+    src = """
+    let comp main = read[int32] >>> repeat {
+      (v : arr[32] int32) <- takes 32;
+      var t : int32 := 5;
+      var out : arr[32] int32;
+      do {
+        for k in [0, 32] {
+          if (v[k] > 0) then { var t : int32 := v[k] * 2; out[k] := t }
+          else { out[k] := 0 - v[k] };
+          t := k * 2
+        }
+      };
+      emits out[0, 32];
+      emit t
+    } >>> write[int32]
+    """
+    xs = ((np.arange(64, dtype=np.int32) * 37) % 101) - 50
+    _engaged(src, xs, False)      # outer t write is not an induction
+
+
+def test_induction_step_reading_local_shadow_stays_fori():
+    # code review r3 #2: induction step referencing a body-local that
+    # shadows an outer name would evaluate against the stale outer
+    # value — must be rejected
+    src = """
+    let comp main = read[int32] >>> repeat {
+      var w : int32 := 100;
+      (v : arr[32] int32) <- takes 32;
+      var s : int32 := 0;
+      var out : arr[32] int32;
+      do {
+        for k in [0, 32] {
+          var w : int32 := 2;
+          out[k] := v[k] + s;
+          s := s + w
+        }
+      };
+      emits out[0, 32];
+      emit s
+    } >>> write[int32]
+    """
+    xs = (np.arange(32, dtype=np.int32) * 3) % 47
+    _engaged(src, xs, False)
+
+
+def test_static_if_fold_respects_local_shadow():
+    # code review r3 #3: a statically-evaluable OUTER name shadowed by
+    # a body local must not let the analysis validate the wrong arm
+    src = """
+    let comp main = read[int32] >>> repeat {
+      let q = 0;
+      (v : arr[32] int32) <- takes 32;
+      var acc : int32 := 1;
+      var out : arr[32] int32;
+      do {
+        for k in [0, 32] {
+          var q : int32 := v[k] % 2;
+          if (q == 0) then { out[k] := v[k] }
+          else { acc := acc * 2; out[k] := 0 }
+        }
+      };
+      emits out[0, 32];
+      emit acc
+    } >>> write[int32]
+    """
+    xs = (np.arange(32, dtype=np.int32) * 3) % 47
+    # conditional outer-scalar write in the live (dynamic) arm: must
+    # NOT vectorize, and results must match the oracle exactly
+    _engaged(src, xs, False)

@@ -985,8 +985,12 @@ def exec_stmt(st: A.Stmt, scope: Scope, ctx: Ctx) -> Optional[Tuple[str, Any]]:
         c = eval_expr(st.c, scope, ctx)
         if is_static(c):
             return exec_stmts(st.then if c else st.els, scope.child(), ctx)
-        if _is_traced(c):
-            return _staged_if(c, st, scope, ctx)   # traced: where-merge
+        if _is_traced(c) or np.ndim(c) >= 1:
+            # traced scalar OR lane-vector condition (vectorized loop
+            # mode: the loop var is a concrete arange, so var-only
+            # conditions like `k >= 16` arrive concrete but
+            # non-scalar): where-merge / per-lane select
+            return _staged_if(c, st, scope, ctx)
         return exec_stmts(st.then if bool(c) else st.els,
                           scope.child(), ctx)      # concrete (np or jnp)
     if isinstance(st, A.SFor):
@@ -1122,6 +1126,302 @@ def _written_cells(stmts, scope: Scope) -> List[Any]:
     return [c for n, c in scope.mutable_cells_named() if n in writes]
 
 
+# elementwise-safe calls a vectorized loop body may contain: base-type
+# casts/constructors plus the elementwise ext math bricks. Anything
+# else (user funs, v_* vector bricks, effects) bails to fori staging.
+_VECTOR_SAFE_CALLS = _BASE_TYPE_NAMES | frozenset(
+    ("sin", "cos", "tan", "atan", "atan2", "sqrt", "exp", "log",
+     "abs", "conj", "floor", "ceil", "round", "sign"))
+
+# kill switch for debugging / A-B timing
+VECTORIZE_STMT_LOOPS = True
+
+
+class _VectorBail(Exception):
+    """Body not vectorizable (analysis or runtime shape failure)."""
+
+
+def _affine_in(e: A.Expr, var: str):
+    """`e` as a*var + b with STATIC int a != 0 and b free of `var`.
+    Returns (a, b_ast_or_int) or None. b is returned as an AST (or 0)
+    to be evaluated loop-invariantly by the caller."""
+    if isinstance(e, A.EVar) and e.name == var:
+        return 1, 0
+    if isinstance(e, A.EBin):
+        if e.op == "+":
+            la, ra = _affine_in(e.a, var), _affine_in(e.b, var)
+            if la is not None and ra is None \
+                    and var not in _free_names(e.b):
+                return la[0], _add_ast(la[1], e.b)
+            if ra is not None and la is None \
+                    and var not in _free_names(e.a):
+                return ra[0], _add_ast(ra[1], e.a)
+        elif e.op == "-":
+            la = _affine_in(e.a, var)
+            if la is not None and var not in _free_names(e.b):
+                return la[0], _sub_ast(la[1], e.b)
+        elif e.op == "*":
+            if isinstance(e.a, A.EInt) and isinstance(e.b, A.EVar) \
+                    and e.b.name == var and e.a.val != 0:
+                return int(e.a.val), 0
+            if isinstance(e.b, A.EInt) and isinstance(e.a, A.EVar) \
+                    and e.a.name == var and e.b.val != 0:
+                return int(e.b.val), 0
+    return None
+
+
+def _free_names(e: Optional[A.Expr]) -> set:
+    out: set = set()
+    _expr_reads(e, out)
+    return out
+
+
+def _add_ast(b, e):
+    if isinstance(b, int) and b == 0:
+        return e
+    ba = A.EInt(val=b) if isinstance(b, int) else b
+    return A.EBin(op="+", a=ba, b=e)
+
+
+def _sub_ast(b, e):
+    ba = A.EInt(val=b) if isinstance(b, int) else b
+    return A.EBin(op="-", a=ba, b=e)
+
+
+def _vector_plan(st: A.SFor, scope: Scope, ctx: Ctx):
+    """Analyze a statement for-loop body for lane-vector execution.
+
+    Eligible bodies contain only: local SCALAR declarations, pure
+    elementwise expressions (whitelisted calls), writes to body-local
+    scalars, ONE unconditional affine-induction update per outer
+    scalar (`v := v +/- c`, c loop-invariant), and element writes to
+    outer arrays whose indices are affine in the loop var with static
+    stride — same-array sites sharing one stride with pairwise
+    distinct static offsets mod stride (so scatter lanes never
+    collide and site order is immaterial across lanes). Written outer
+    arrays must not be read. No nested loops, no local arrays (their
+    per-iteration privacy has no lane representation), no returns.
+
+    Returns {"inductions": {name: (sign, step_ast)}} or None.
+    """
+    var = st.var
+    decl_names: set = set()     # every name declared ANYWHERE in body
+    inductions: dict = {}
+    arr_sites: dict = {}        # name -> list[(a, b_static_or_None)]
+    reads: set = set()
+
+    def expr_ok(e) -> bool:
+        for x in A.iter_exprs(e):
+            if isinstance(x, A.ECall):
+                if x.name not in _VECTOR_SAFE_CALLS:
+                    return False
+            elif isinstance(x, A.ESlice):
+                # slice reads with var-dependent starts have no single
+                # gather form; allow only var-free slices
+                if var in _free_names(x.i):
+                    return False
+        return True
+
+    def note_reads(e):
+        reads.update(_free_names(e))
+
+    def walk(stmts, in_if: bool, outer_locals: set) -> bool:
+        # lexically-scoped local tracking: a declaration is visible
+        # from its statement onward WITHIN this block (and nested
+        # arms), and dies with the block — an arm-local must not make
+        # a later outer-scalar write look local (code review r3)
+        lc = set(outer_locals)
+        for s in stmts:
+            if isinstance(s, (A.SWhile, A.SFor, A.SReturn)):
+                return False
+            if isinstance(s, (A.SVar, A.SLet)):
+                if s.name == var:
+                    return False
+                if isinstance(s.ty, A.TArr):
+                    return False   # local array: no lane privacy
+                init = s.init if isinstance(s, A.SVar) else s.e
+                if init is not None and not expr_ok(init):
+                    return False
+                if init is not None:
+                    note_reads(init)
+                lc.add(s.name)
+                decl_names.add(s.name)
+            elif isinstance(s, A.SIf):
+                # statically-decided branches (rate-dispatch literals):
+                # analyze only the live arm, mirroring exec_stmt's
+                # fold — dead arms would otherwise poison the plan
+                # (e.g. mixed demap strides across nbpsc arms). Only
+                # safe when no body-local shadows a condition name:
+                # execution resolves the LOCAL, the fold saw the outer
+                if not (_free_names(s.c) & lc) and var not in \
+                        _free_names(s.c):
+                    try:
+                        cv = ctx.static_eval(s.c, scope)
+                    except Exception:
+                        cv = None
+                    if cv is not None and is_static(cv):
+                        if not walk(s.then if cv else s.els, in_if, lc):
+                            return False
+                        continue
+                if not expr_ok(s.c):
+                    return False
+                note_reads(s.c)
+                if not walk(s.then, True, lc) \
+                        or not walk(s.els, True, lc):
+                    return False
+            elif isinstance(s, A.SAssign):
+                if not expr_ok(s.e):
+                    return False
+                note_reads(s.e)
+                lv = s.lval
+                if isinstance(lv, A.EVar):
+                    if lv.name in lc:
+                        continue
+                    cell = scope.find(lv.name)
+                    if cell is None or not cell.mutable:
+                        return False
+                    # outer scalar: single unconditional affine
+                    # induction only
+                    if in_if or lv.name in inductions:
+                        return False
+                    e = s.e
+                    if isinstance(e, A.EBin) and e.op in "+-":
+                        if isinstance(e.a, A.EVar) \
+                                and e.a.name == lv.name \
+                                and lv.name not in _free_names(e.b) \
+                                and var not in _free_names(e.b) \
+                                and expr_ok(e.b):
+                            inductions[lv.name] = (
+                                1 if e.op == "+" else -1, e.b)
+                            continue
+                        if e.op == "+" and isinstance(e.b, A.EVar) \
+                                and e.b.name == lv.name \
+                                and lv.name not in _free_names(e.a) \
+                                and var not in _free_names(e.a) \
+                                and expr_ok(e.a):
+                            inductions[lv.name] = (1, e.a)
+                            continue
+                    return False
+                elif isinstance(lv, A.EIdx) \
+                        and isinstance(lv.arr, A.EVar):
+                    name = lv.arr.name
+                    if name in lc:
+                        return False   # local arrays already rejected
+                    cell = scope.find(name)
+                    if cell is None or not cell.mutable:
+                        return False
+                    if not expr_ok(lv.i):
+                        return False
+                    aff = _affine_in(lv.i, var)
+                    if aff is None:
+                        return False
+                    a, b = aff
+                    note_reads(lv.i)
+                    b_static = b if isinstance(b, int) else (
+                        int(b.val) if isinstance(b, A.EInt) else None)
+                    arr_sites.setdefault(name, []).append((a, b_static))
+                else:
+                    return False
+            elif isinstance(s, A.SExpr):
+                return False       # call for effect: not vectorizable
+            else:
+                return False
+        return True
+
+    if not walk(st.body, False, set()):
+        return None
+    # written arrays: never read, and same-array sites must provably
+    # never collide across lanes or sites
+    for name, sites in arr_sites.items():
+        if name in reads:
+            return None
+        if len(sites) > 1:
+            a0 = sites[0][0]
+            if any(a != a0 or b is None for a, b in sites):
+                return None
+            offs = [b % abs(a0) for _a, b in sites]
+            if len(set(offs)) != len(offs):
+                return None
+    # induction steps are evaluated ONCE in the OUTER scope: they must
+    # not read anything the body writes OR declares (a body-local
+    # shadowing an outer name would evaluate to the wrong value)
+    written = set(arr_sites) | set(inductions)
+    for name, (_sgn, step) in inductions.items():
+        if _free_names(step) & (written | decl_names):
+            return None
+    return {"inductions": inductions}
+
+
+def _vectorized_for(start: int, count: int, st: A.SFor, scope: Scope,
+                    ctx: Ctx) -> bool:
+    """Execute an eligible statement loop as ONE lane-vector pass:
+    the loop variable becomes arange(n), scalar locals become lane
+    vectors, data-dependent ifs become per-lane selects (the value-
+    select machinery), and outer-array element writes become single
+    scatters — the reference vectorizer's widening, applied to
+    statement loops (SURVEY.md §2.1 Vectorize), which also removes
+    the per-iteration while-op cost on the VPU. Returns True when it
+    ran; False leaves all state untouched (caller falls back to
+    lax.fori_loop staging)."""
+    import os
+    if not VECTORIZE_STMT_LOOPS \
+            or os.environ.get("ZIRIA_NO_VECTOR_LOOPS"):
+        return False
+    plan = _vector_plan(st, scope, ctx)
+    if plan is None:
+        return False
+    jnp = _jnp()
+    n = int(count)
+
+    # rollback snapshot: every mutable cell value currently visible
+    snap = [(c, c.value) for _n, c in scope.mutable_cells_named()]
+    try:
+        vs = scope.child()
+        i_vec = jnp.arange(start, start + n, dtype=jnp.int32)
+        vs.declare(st.var, i_vec, None, mutable=False)
+        finals: dict = {}
+        for name, (sgn, step_ast) in plan["inductions"].items():
+            v0 = scope.lookup(name, st.loc)
+            c = eval_expr(step_ast, scope, ctx)     # loop-invariant
+            if np.ndim(c) != 0 or np.ndim(v0) != 0:
+                raise _VectorBail("non-scalar induction")
+            stepv = c if sgn > 0 else -c
+            if np.issubdtype(jnp.asarray(v0).dtype, np.integer) \
+                    and np.issubdtype(jnp.asarray(stepv).dtype,
+                                      np.integer):
+                starts = v0 + jnp.arange(n) * stepv   # exact closed form
+                finals[name] = v0 + n * stepv
+            else:
+                # float induction: reproduce SEQUENTIAL accumulation
+                # bit-for-bit (closed form rounds differently)
+                from jax import lax
+
+                def acc_fn(a, _x, _c=stepv):
+                    nxt = a + _c
+                    return nxt, a
+
+                end, starts = lax.scan(
+                    acc_fn, jnp.asarray(v0), None, length=n)
+                finals[name] = end
+            # shadow cell: body updates hit the lane vector, the final
+            # scalar goes to the outer cell afterwards
+            vs.declare(name, starts, None, mutable=True)
+
+        r = exec_stmts(st.body, vs, ctx)
+        if r is not None:                 # pragma: no cover - walked
+            raise _VectorBail("return inside vector loop")
+        for name, fin in finals.items():
+            scope.assign(name, fin, ctx, st.loc)
+        return True
+    except Exception:
+        # any failure (analysis gap surfacing as a shape/type error)
+        # restores every cell and falls back to fori staging, which
+        # re-raises genuine program errors with proper diagnostics
+        for c, v in snap:
+            c.value = v
+        return False
+
+
 def _staged_for(start, count, st: A.SFor, scope: Scope,
                 ctx: Ctx):
     """Stage one statement for-loop as `lax.fori_loop` carrying the
@@ -1133,6 +1433,14 @@ def _staged_for(start, count, st: A.SFor, scope: Scope,
     import jax
     from jax import lax
     jnp = _jnp()
+
+    # try the lane-vector lowering first: eligible bodies (affine
+    # scatters, per-lane selects, induction closed forms) run as ONE
+    # vector pass instead of `count` while-loop iterations
+    if isinstance(start, int) and isinstance(count, int) \
+            and _vectorized_for(start, count, st, scope, ctx):
+        return None
+
     cells = _written_cells(st.body, scope)
 
     try:
@@ -1254,7 +1562,7 @@ def _staged_while(st: A.SWhile, scope: Scope, ctx: Ctx):
     return None
 
 
-def _value_select_plans(st: A.SIf, scope: Scope):
+def _value_select_plans(st: A.SIf, scope: Scope, size_floor: int = 4096):
     """Big-buffer writes mergeable at VALUE level instead of buffer
     level. The default staged-if merge selects whole cell values; for
     `if c then { dep[i] := e1 } else { dep[i] := e2 }` over a 131072-
@@ -1282,7 +1590,7 @@ def _value_select_plans(st: A.SIf, scope: Scope):
         if cell is None or not cell.mutable:
             continue
         try:
-            if np.size(cell.value) <= 4096:
+            if np.size(cell.value) <= size_floor:
                 continue
         except Exception:       # pragma: no cover - exotic cell values
             continue
@@ -1334,7 +1642,14 @@ def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):
     (`_value_select_plans`) so the merge never copies frame buffers."""
     jnp = _jnp()
 
-    plans = _value_select_plans(st, scope)
+    # lane-vector condition (vectorized statement loop): EVERY array
+    # element write must go through the value-select rewrite — the
+    # whole-cell where-merge cannot express a per-lane scatter. An
+    # uncoverable write then fails the merge's shape check, which the
+    # vectorizer catches to fall back to fori staging.
+    vec_mode = getattr(cond, "ndim", 0) and np.ndim(cond) >= 1
+    plans = _value_select_plans(st, scope,
+                                size_floor=0 if vec_mode else 4096)
     if plans:
         import dataclasses
         tmps = {}
@@ -1391,13 +1706,23 @@ def _staged_if(cond, st: A.SIf, scope: Scope, ctx: Ctx):
             return {k: (t[k] if k == "__struct__" else merge(t[k], f[k]))
                     for k in t}
         ta, fa = jnp.asarray(t), jnp.asarray(f)
-        if ta.shape != fa.shape:
+        if ta.shape != fa.shape and np.ndim(cond) == 0:
             raise _rt_err(
                 st.loc, f"data-dependent if assigns incompatible shapes "
                         f"{ta.shape} vs {fa.shape} to the same variable; "
                         f"under staging both arms must produce the same "
                         f"shape (the merge is a jnp.where select)")
-        return jnp.where(cond, ta, fa)
+        c = jnp.asarray(cond)
+        if c.ndim:
+            # vectorized-loop mode (lane-vector condition): values may
+            # carry trailing dims (fxp pairs) or still be pre-vector
+            # scalars from an untaken path — right-expand the cond to
+            # the wider side and let broadcasting unify; a genuine
+            # incompatibility raises and the vectorizer falls back
+            nd = max(ta.ndim, fa.ndim)
+            if nd > c.ndim:
+                c = c.reshape(c.shape + (1,) * (nd - c.ndim))
+        return jnp.where(c, ta, fa)
 
     for c, b, t, f in zip(cells, before, after_then, after_else):
         if t is b and f is b:
@@ -1422,7 +1747,12 @@ def _assign_lval(lval: A.Expr, v: Any, scope: Scope, ctx: Ctx) -> None:
             # concrete path: copy-on-write keeps the functional
             # semantics (arrays are values) at numpy speed
             new = np.array(old)
-            new[int(i)] = np.asarray(v).astype(new.dtype, copy=False)
+            if np.ndim(i) > 0:       # lane-vector scatter
+                new[np.asarray(i)] = np.asarray(v).astype(
+                    new.dtype, copy=False)
+            else:
+                new[int(i)] = np.asarray(v).astype(new.dtype,
+                                                   copy=False)
         else:
             new = jnp.asarray(old).at[i].set(
                 jnp.asarray(v, dtype=jnp.asarray(old).dtype))
