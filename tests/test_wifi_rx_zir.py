@@ -133,8 +133,8 @@ def test_wifi_rx_zir_continuous_two_frames():
         ">>> write[bit]", src_txt)
     prog = compile_source(src_txt)
 
-    psdu1, x1 = _capture(24, 60, seed=31)
-    psdu2, x2 = _capture(54, 90, seed=32)
+    psdu1, x1 = _impaired_capture(24, 60, seed=31)
+    psdu2, x2 = _impaired_capture(54, 90, seed=32)
     xs = list(np.concatenate([np.asarray(x1), np.asarray(x2)], axis=0))
     want = np.concatenate([np.asarray(bytes_to_bits(psdu1)),
                            np.asarray(bytes_to_bits(psdu2))])

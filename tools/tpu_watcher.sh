@@ -18,12 +18,17 @@
 set -u
 cd /root/repo
 LOG=/root/repo/BENCH_LIVE.log
+PROBES=/root/repo/BENCH_PROBES.jsonl   # machine-readable availability ledger
 DEADLINE=$(( $(date +%s) + 42000 ))   # ~11.5 h
-echo "[watcher] start chain-v3 $(date -u +%H:%M:%S)" >> "$LOG"
+echo "[watcher] start chain-v4 $(date -u +%H:%M:%S)" >> "$LOG"
+probe_log() {  # probe_log ok|fail|busy
+  echo "{\"t\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"probe\": \"$1\"}" >> "$PROBES"
+}
 while [ "$(date +%s)" -lt "$DEADLINE" ] && [ ! -e /tmp/stop_tpu_watcher ]; do
   # take the flag atomically BEFORE touching the backend: the probe
   # itself is a TPU client, and a concurrent bench.py would hang both
   if ! ( set -C; echo "watcher pid $$" > /tmp/tpu_busy ) 2>/dev/null; then
+    probe_log busy
     sleep 60
     continue
   fi
@@ -33,6 +38,7 @@ d = jax.devices()[0]
 assert d.platform != 'cpu', d.platform
 print('probe ok:', d.platform, d.device_kind)
 " >> "$LOG" 2>&1; then
+    probe_log ok
     echo "[watcher] probe ok $(date -u +%H:%M:%S); running bench" >> "$LOG"
     timeout -k 15 1500 env TPU_BUSY_HELD=1 python bench.py > /root/repo/BENCH_LIVE.json.tmp 2>> "$LOG"
     rc=$?
@@ -76,6 +82,7 @@ sys.exit(0 if j.get('platform') not in (None,'cpu') else 1)
     pkill -9 -f "bench.py --tpu-" 2>/dev/null   # child AND probe modes
     rm -f /tmp/tpu_busy
   else
+    probe_log fail
     echo "[watcher] probe failed/hung $(date -u +%H:%M:%S)" >> "$LOG"
     rm -f /tmp/tpu_busy     # release the flag taken before the probe
   fi

@@ -783,6 +783,12 @@ class _ChunkLoop(ir.Comp):
                         e.bind(orig.var, it)
                     yield from _run(self._fallback_comp().body, e,
                                     source, xp)
+                    # the interpreter mutated carried refs directly in
+                    # env; refresh vals so a later chunk step (or the
+                    # final/fallback write_back) doesn't clobber them
+                    # with stale pre-tail device values
+                    for m in names:
+                        vals[name_idx[m]] = env.lookup(m)
                     it += 1
                     continue
 

@@ -214,6 +214,17 @@ def stream_parallel(comp: ir.Comp, inputs, mesh: Mesh,
         device_warm = 0 < warm_iters <= per and n_dev > 1
         if device_warm:
             small = lower(comp, width=1)
+            # the warm scan steps width-1 carries into the width-`share`
+            # lowering's entry carry: that only works while the carry
+            # pytree is width-independent. Verify, and fall back to the
+            # host carry_at path on any mismatch rather than corrupting
+            # warmup silently (ADVICE r3).
+            def _sig(c):
+                return jax.tree_util.tree_map(
+                    lambda x: (jnp.shape(x), jnp.asarray(x).dtype), c)
+            if _sig(small.init_carry) != _sig(big.init_carry):
+                device_warm = False
+        if device_warm:
             warm_take = warm_iters * small.take
             carries = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs),
