@@ -68,6 +68,29 @@ class _Unstageable(Exception):
     """Structural reason this subtree cannot be chunk-compiled."""
 
 
+# ---------------------------------------------------------------------
+# Device-step indirection: every chunk step goes through _step_call so a
+# frame batcher (backend/framebatch.py) can intercept it. Single-frame
+# runs call the node's jitted fn directly; under run_many each frame
+# thread parks here and N lanes ride ONE vmapped call. STATS counts
+# device calls either way — the unit tests' call-budget assertions and
+# bench.py's call-amortization evidence both read it.
+
+import threading as _threading
+
+_TLS = _threading.local()
+STATS = {"device_calls": 0}
+
+
+def _step_call(node: "_ChunkLoop", key, args):
+    b = getattr(_TLS, "batcher", None)
+    if b is not None:
+        return b.call(node, key, args)
+    out = node._fns[key](*args)
+    STATS["device_calls"] += 1   # after: a failed first trace is not a call
+    return out
+
+
 class _Unboundable(_Unstageable):
     pass
 
@@ -572,6 +595,7 @@ class _ChunkLoop(ir.Comp):
     def __init__(self, orig: ir.Comp):
         object.__setattr__(self, "orig", orig)
         object.__setattr__(self, "_fns", {})
+        object.__setattr__(self, "_steps", {})
         object.__setattr__(self, "_ok_keys", set())
         object.__setattr__(self, "_broken", False)
         object.__setattr__(self, "_fb", None)
@@ -670,6 +694,10 @@ class _ChunkLoop(ir.Comp):
             return jax.lax.while_loop(cond_fn, body_fn, carry)
 
         fn = jax.jit(step)
+        # _steps must be visible before _fns: a concurrent frame thread
+        # that sees the cached fn may immediately park a request whose
+        # batched fire reads _steps[key]
+        self._steps[key] = step
         self._fns[key] = fn
         return key, fn
 
@@ -728,8 +756,8 @@ class _ChunkLoop(ir.Comp):
                     names = names + [
                         m for m in sorted(free_vars(ast))
                         if m not in names and _resolves_ref(env, m)]
-            key, fn = self._get_fn(struct, names, take_b, out_cap,
-                                   is_for, orig.var if is_for else None)
+            key, _ = self._get_fn(struct, names, take_b, out_cap,
+                                  is_for, orig.var if is_for else None)
         except _Unstageable:
             return (yield from fallback())
 
@@ -804,9 +832,10 @@ class _ChunkLoop(ir.Comp):
                 chunk = np.zeros((1,), np.int32)
 
             try:
-                it_a, pos_a, out_n_a, out_buf_a, rvals_a = fn(
-                    jnp.asarray(chunk), jnp.int32(avail), jnp.int32(n),
-                    jnp.int32(it), tuple(vals))
+                it_a, pos_a, out_n_a, out_buf_a, rvals_a = _step_call(
+                    self, key,
+                    (jnp.asarray(chunk), jnp.int32(avail), jnp.int32(n),
+                     jnp.int32(it), tuple(vals)))
                 self._ok_keys.add(key)
             except Exception:
                 if key in self._ok_keys:

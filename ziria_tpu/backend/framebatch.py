@@ -1,0 +1,200 @@
+"""Frame batching for chunked state machines: N independent streams,
+one device call per step.
+
+The reference ran one PHY pipeline per thread and scaled frames by
+adding threads (SURVEY.md §2.2 thread separators); a TPU behind a host
+link scales the other way — batch the *device work* of many frames into
+single calls so the per-call round-trip (tens of ms through the axon
+tunnel) amortizes across frames. The library receiver already does this
+with a leading frame axis (phy/wifi/rx.py). This module gives the same
+economics to ANY compiled `.zir` program (VERDICT r3 next #3): a
+1000-byte DSL receive costs ~8 device calls; 16 frames through
+`run_many` cost ~the same 8 vmapped calls, not 128.
+
+Design — continuation batching over the interpreter:
+
+- each frame runs the normal interpreter/hybrid executor in its own
+  thread (host control flow stays per-frame Python: divergent rates,
+  ragged lengths, interpreter EOF tails all Just Work);
+- when a frame's `_ChunkLoop` needs a device step it *parks* its
+  request in the shared :class:`StepBatcher` (`chunked._step_call`
+  routes here via a thread-local);
+- when every unfinished frame is parked, the quorum thread fires:
+  requests are grouped by (machine, jit key, operand shapes), each
+  group's operands are stacked and run through ONE `jax.vmap`-ped step
+  — JAX's `lax.while_loop` batching rule executes while ANY lane's
+  guard holds and `select`s per-lane carries, so lanes consume their
+  own cursors/iteration counts and bit-exactness per lane is preserved
+  — and every parked frame resumes with its lane of the result.
+
+Frames that drift to different program points simply land in different
+groups (two smaller calls); frames in lockstep — the common case for
+same-shape captures — ride one call. Lane counts are padded to the
+next power of two (lane 0 repeated) so XLA compiles O(log N) batched
+variants, not one per group size.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ziria_tpu.backend import chunked as C
+from ziria_tpu.core import ir
+
+
+def _shape_sig(args):
+    import jax
+    return tuple(
+        (tuple(np.shape(x)), np.asarray(x).dtype.str) if not hasattr(
+            x, "aval") else (tuple(x.shape), x.dtype.str)
+        for x in jax.tree_util.tree_leaves(args))
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class _Req:
+    __slots__ = ("node", "key", "args", "done", "result", "exc")
+
+    def __init__(self, node, key, args):
+        self.node = node
+        self.key = key
+        self.args = args
+        self.done = False
+        self.result = None
+        self.exc: Optional[BaseException] = None
+
+
+class StepBatcher:
+    """Collects concurrent chunk-step requests from frame threads and
+    services them in vmapped groups. `device_calls` counts actual
+    device dispatches (one per fired group) — the number the frame-
+    batching contract is about."""
+
+    def __init__(self, n_frames: int):
+        self._cv = threading.Condition()
+        self._active = n_frames
+        self._parked: List[_Req] = []
+        self._vfns = {}
+        self.device_calls = 0
+        self.group_sizes: List[int] = []   # fired lane counts (stats)
+
+    # -- frame lifecycle ------------------------------------------------
+
+    def frame_finished(self) -> None:
+        with self._cv:
+            self._active -= 1
+            if self._parked and len(self._parked) >= self._active:
+                self._fire_locked()
+
+    # -- the park point (called from chunked._step_call) ---------------
+
+    def call(self, node, key, args):
+        req = _Req(node, key, args)
+        with self._cv:
+            self._parked.append(req)
+            if len(self._parked) >= self._active:
+                self._fire_locked()
+            while not req.done:
+                self._cv.wait()
+        if req.exc is not None:
+            raise req.exc
+        return req.result
+
+    # -- firing ---------------------------------------------------------
+
+    def _vfn(self, node, key):
+        import jax
+        k = (id(node), key)
+        f = self._vfns.get(k)
+        if f is None:
+            f = jax.jit(jax.vmap(node._steps[key]))
+            self._vfns[k] = f
+        return f
+
+    def _fire_locked(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        batch, self._parked = self._parked, []
+        groups = {}
+        for r in batch:
+            sig = (id(r.node), r.key, _shape_sig(r.args))
+            groups.setdefault(sig, []).append(r)
+        for reqs in groups.values():
+            try:
+                if len(reqs) == 1:
+                    r = reqs[0]
+                    r.result = r.node._fns[r.key](*r.args)
+                else:
+                    lanes = len(reqs)
+                    padded = reqs + [reqs[0]] * (_pow2(lanes) - lanes)
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs),
+                        *[r.args for r in padded])
+                    out = self._vfn(reqs[0].node, reqs[0].key)(*stacked)
+                    for i, r in enumerate(reqs):
+                        r.result = jax.tree_util.tree_map(
+                            lambda x, i=i: x[i], out)
+                C.STATS["device_calls"] += 1
+                self.device_calls += 1
+                self.group_sizes.append(len(reqs))
+            except Exception as e:  # delivered to every lane's thread
+                for r in reqs:
+                    r.exc = e
+            for r in reqs:
+                r.done = True
+        self._cv.notify_all()
+
+
+def run_many(comp: ir.Comp, frames: Sequence[Sequence[Any]],
+             max_out: Optional[int] = None,
+             batcher: Optional[StepBatcher] = None) -> List[Any]:
+    """Run `comp` once per entry of `frames` (each an independent input
+    stream), batching chunk-machine device steps across frames. Returns
+    the per-frame :class:`interp.Result`s, bit-identical to running
+    each frame alone. Pass a hybridized comp (`hybrid.hybridize`) —
+    a plain comp works too, it just has no device steps to batch."""
+    from ziria_tpu.interp.interp import run
+
+    n = len(frames)
+    if n == 0:
+        return []
+    if n == 1:   # no threads, no batcher: exactly the single-frame path
+        return [run(comp, list(frames[0]), max_out=max_out)]
+
+    b = batcher if batcher is not None else StepBatcher(n)
+    with b._cv:
+        b._active = n   # reconcile a caller-supplied/reused batcher:
+        b._parked.clear()  # a stale count deadlocks or defeats batching
+    results: List[Any] = [None] * n
+    errors: List[Optional[BaseException]] = [None] * n
+
+    def worker(i: int, xs) -> None:
+        C._TLS.batcher = b
+        try:
+            results[i] = run(comp, list(xs), max_out=max_out)
+        except BaseException as e:
+            errors[i] = e
+        finally:
+            C._TLS.batcher = None
+            b.frame_finished()
+
+    threads = [threading.Thread(target=worker, args=(i, xs),
+                                name=f"ziria-frame-{i}", daemon=True)
+               for i, xs in enumerate(frames)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
