@@ -181,3 +181,33 @@ def test_mixed_rate_captures_exact():
         np.testing.assert_array_equal(
             np.asarray(g.out_array(), np.uint8),
             np.asarray(bytes_to_bits(psdu)))
+
+
+def test_vmap_failure_degrades_to_singles():
+    # code review r4: a vmap-only failure must not abort frames whose
+    # per-frame step works, nor mark the shared machine broken — the
+    # batcher retries each lane unbatched
+    hyb = H.hybridize(compile_source(TAKE_BRANCH_SRC).comp)
+    frames = [(np.arange(300, dtype=np.int32) * k + 1) % 97
+              for k in range(1, 5)]
+    want = [run(hyb, list(f)) for f in frames]
+
+    class BrokenVmap(StepBatcher):
+        def _vfn(self, node, key):
+            def boom(*a):
+                raise RuntimeError("synthetic vmap failure")
+            return boom
+
+    b = BrokenVmap(len(frames))
+    got = run_many(hyb, frames, batcher=b)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w.out_array()),
+                                      np.asarray(g.out_array()))
+    assert all(s == 1 for s in b.group_sizes)
+    # the machines must still be healthy for later batched runs
+    b2 = StepBatcher(len(frames))
+    got2 = run_many(hyb, frames, batcher=b2)
+    for w, g in zip(want, got2):
+        np.testing.assert_array_equal(np.asarray(w.out_array()),
+                                      np.asarray(g.out_array()))
+    assert max(b2.group_sizes) == len(frames)

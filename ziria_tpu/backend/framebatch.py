@@ -120,10 +120,24 @@ class StepBatcher:
         return f
 
     def _fire_locked(self) -> None:
+        batch, self._parked = self._parked, []
+        try:
+            self._service(batch)
+        finally:
+            # every parked thread MUST wake whatever happened above —
+            # a request left done=False would wait forever
+            for r in batch:
+                if not r.done:
+                    if r.exc is None and r.result is None:
+                        r.exc = RuntimeError(
+                            "step batch aborted before this lane ran")
+                    r.done = True
+            self._cv.notify_all()
+
+    def _service(self, batch: List[_Req]) -> None:
         import jax
         import jax.numpy as jnp
 
-        batch, self._parked = self._parked, []
         groups = {}
         for r in batch:
             sig = (id(r.node), r.key, _shape_sig(r.args))
@@ -146,12 +160,21 @@ class StepBatcher:
                 C.STATS["device_calls"] += 1
                 self.device_calls += 1
                 self.group_sizes.append(len(reqs))
-            except Exception as e:  # delivered to every lane's thread
+            except Exception:
+                # a vmap-only failure must not abort frames whose
+                # per-frame step is fine (or worse, mark the shared
+                # machine broken): retry each lane unbatched; only a
+                # lane whose OWN direct call fails gets the exception
                 for r in reqs:
-                    r.exc = e
+                    try:
+                        r.result = r.node._fns[r.key](*r.args)
+                        C.STATS["device_calls"] += 1
+                        self.device_calls += 1
+                        self.group_sizes.append(1)
+                    except Exception as le:
+                        r.exc = le
             for r in reqs:
                 r.done = True
-        self._cv.notify_all()
 
 
 def run_many(comp: ir.Comp, frames: Sequence[Sequence[Any]],
