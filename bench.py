@@ -340,6 +340,49 @@ def _child_main(run_id):
     note("Pallas kernels compiled by Mosaic, match oracle")
     _partial(run_id, "pallas_mosaic", pallas_mosaic=True)
 
+    # Frame batching on-chip (r4): any compiled .zir program amortizes
+    # the host link across frames — 16 captures through the in-language
+    # receiver should ride ~the single-frame device-call count. Timed
+    # here because the win is exactly the per-call tunnel cost the
+    # marginal-step methodology above factors out.
+    try:
+        from ziria_tpu.backend import chunked as CH
+        from ziria_tpu.backend import hybrid as HY
+        from ziria_tpu.backend.framebatch import StepBatcher, run_many
+        from ziria_tpu.frontend import compile_file
+        from ziria_tpu.interp.interp import run as interp_run
+        from ziria_tpu.phy import channel
+
+        hyb = HY.hybridize(compile_file(
+            os.path.join(REPO, "examples", "wifi_rx.zir")).comp)
+        caps = [channel.impaired_capture(24, 60, seed=100 + k,
+                                         add_fcs=True)
+                for k in range(16)]
+        streams = [[p for p in xi] for _ps, xi in caps]
+        interp_run(hyb, streams[0])              # compile single path
+        CH.STATS["device_calls"] = 0
+        ts = time.perf_counter()
+        for s in streams:
+            interp_run(hyb, s)
+        t_seq = time.perf_counter() - ts
+        calls_seq = CH.STATS["device_calls"]
+        run_many(hyb, streams,
+                 batcher=StepBatcher(len(streams)))  # compile vmap path
+        b2 = StepBatcher(len(streams))
+        ts = time.perf_counter()
+        run_many(hyb, streams, batcher=b2)
+        t_bat = time.perf_counter() - ts
+        fb = {"frames": len(streams), "calls_sequential": calls_seq,
+              "calls_batched": b2.device_calls,
+              "t_sequential_s": round(t_seq, 3),
+              "t_batched_s": round(t_bat, 3)}
+        note(f"framebatch: {calls_seq} calls / {t_seq:.2f}s sequential"
+             f" -> {b2.device_calls} calls / {t_bat:.2f}s batched")
+        _partial(run_id, "framebatch", **fb)
+    except Exception as e:            # evidence stage: never fatal
+        note(f"framebatch stage failed: {e!r}")
+        fb = {"error": repr(e)}
+
     # per-call diagnostic (tunnel-dispatch-bound upper bound on latency)
     t_percall = _time(decode, frames, reps=50)
     note(f"t_marginal={t_tpu*1e3:.3f} ms t_percall={t_percall*1e3:.3f} ms")
@@ -379,6 +422,7 @@ def _child_main(run_id):
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
         "pallas_mosaic": pallas_mosaic,
+        "framebatch": fb,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
     }
     _partial(run_id, "complete", **out)

@@ -87,7 +87,8 @@ def _rx_capture(mbps, n_bytes, seed):
     # main() pins the CPU platform before any case builder runs
     from ziria_tpu.phy.channel import impaired_capture
 
-    _psdu, xi = impaired_capture(mbps, n_bytes, seed, floor=0.02)
+    _psdu, xi = impaired_capture(mbps, n_bytes, seed, floor=0.02,
+                                 add_fcs=True)
     return xi
 
 # cases compiled under the fixed-point complex16 policy

@@ -292,3 +292,70 @@ def test_interp_tail_then_more_chunk_steps():
         np.testing.assert_array_equal(np.asarray(want.out_array()),
                                       np.asarray(got.out_array()))
         assert want.terminated_by == got.terminated_by
+
+
+EMIT_WHILE_SRC = """
+let comp main = read[int32] >>> {
+  var s : int32 := 0;
+  var h : int32 := 1;
+  var armed : bool := false;
+  while (!armed) {
+    x <- take;
+    do {
+      s := s + x * h;
+      h := (h * 31 + 7) % 101;
+      if (s % 977 > 900) then { armed := true }
+    };
+    emit s;
+    emit h
+  };
+  emit 0 - s
+} >>> write[int32]
+"""
+
+
+def test_emitting_while_chunked():
+    # VERDICT r3 next #7: a detect-then-emit While runs as a chunked
+    # machine — emissions bounded per chunk by the iteration cap
+    xs = (np.arange(3000, dtype=np.int32) * 13) % 37
+    hyb = _assert_match(EMIT_WHILE_SRC, xs, min_chunks=1,
+                        check_consumed=False)
+    # the machine actually compiled and ran (not a silent fallback)
+    assert all(n._fns for n in _chunk_nodes(hyb))
+
+
+def test_emitting_while_eof_midway():
+    prog = compile_source(EMIT_WHILE_SRC)
+    hyb = H.hybridize(prog.comp)
+    assert len(_chunk_nodes(hyb)) >= 1
+    for n in (0, 1, 5, 63):
+        xs = np.ones(n, np.int32)     # may never arm: EOF inside loop
+        want = run(prog.comp, list(xs))
+        got = run(hyb, list(xs))
+        np.testing.assert_array_equal(np.asarray(want.out_array()),
+                                      np.asarray(got.out_array()))
+        assert want.terminated_by == got.terminated_by
+
+
+def test_emitting_while_small_iter_cap(monkeypatch):
+    # force a tiny output budget so one execution needs MANY chunk
+    # steps — the cap/flush/re-enter cycle must stay exact
+    from ziria_tpu.backend import chunked as CH
+    monkeypatch.setattr(CH, "WHILE_OUT_ITEMS", 32)
+    xs = (np.arange(3000, dtype=np.int32) * 13) % 37
+    _assert_match(EMIT_WHILE_SRC, xs, min_chunks=1,
+                  check_consumed=False)
+
+
+def test_emitting_while_fuzz_oracle():
+    prog = compile_source(EMIT_WHILE_SRC)
+    hyb = H.hybridize(prog.comp)
+    rng = np.random.default_rng(17)
+    for _ in range(5):
+        n = int(rng.integers(0, 4000))
+        xs = rng.integers(0, 50, n).astype(np.int32)
+        want = run(prog.comp, list(xs))
+        got = run(hyb, list(xs))
+        np.testing.assert_array_equal(np.asarray(want.out_array()),
+                                      np.asarray(got.out_array()))
+        assert want.terminated_by == got.terminated_by

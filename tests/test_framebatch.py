@@ -138,7 +138,8 @@ def test_wifi_rx_zir_16_captures():
     hyb = H.hybridize(compile_file(src).comp)
 
     mbps, n_bytes = 24, 60
-    caps = [channel.impaired_capture(mbps, n_bytes, seed=100 + k)
+    caps = [channel.impaired_capture(mbps, n_bytes, seed=100 + k,
+                                     add_fcs=True)
             for k in range(16)]
     for psdu, xi in caps:
         assert rx.receive(xi.astype(np.float32)).ok
@@ -174,7 +175,7 @@ def test_mixed_rate_captures_exact():
     src = os.path.join(os.path.dirname(__file__), "..", "examples",
                        "wifi_rx.zir")
     hyb = H.hybridize(compile_file(src).comp)
-    caps = [channel.impaired_capture(m, nb, seed=m)
+    caps = [channel.impaired_capture(m, nb, seed=m, add_fcs=True)
             for m, nb in ((6, 30), (24, 60), (54, 90))]
     got = run_many(hyb, [[p for p in xi] for _psdu, xi in caps])
     for (psdu, _xi), g in zip(caps, got):

@@ -22,7 +22,9 @@ SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
 
 def _capture(mbps, n_bytes, seed, cfo=0.002):
     from ziria_tpu.phy import channel
-    return channel.impaired_capture(mbps, n_bytes, seed, cfo=cfo)
+    # FCS appended: the in-language receiver validates and strips it
+    return channel.impaired_capture(mbps, n_bytes, seed, cfo=cfo,
+                                    add_fcs=True)
 
 
 @pytest.mark.parametrize("mbps,n_bytes", [(6, 30), (24, 60), (54, 90)])

@@ -260,3 +260,45 @@ let comp main = read[int32] >>> map inc |>>>| map dbl >>> write[int32]
     assert rc == 0
     got = read_stream(StreamSpec(ty="int32", path=str(outf), mode="bin"))
     np.testing.assert_array_equal(np.asarray(got), (xs + 1) * 2)
+
+
+def test_dp_x_pp_per_stream_exit_carries():
+    """VERDICT r3 next #6: the batched (dp x pp) path exposes one exit
+    carry per stream, so each stream's ragged remainder can continue on
+    the single-device path — exact equality with the per-stream fused
+    run over bulk + remainder."""
+    import jax
+    from jax.sharding import Mesh
+    import ziria_tpu as z
+    from ziria_tpu.backend.execute import run_jit, run_jit_carry
+    from ziria_tpu.parallel import lower_stage_parallel
+    from ziria_tpu.parallel import shard_batch
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "pp"))
+    stages = [
+        z.zmap(lambda x: x * 2.0, name="s0"),
+        z.map_accum(lambda s, x: (s + x, s + x), 0.0, name="cumsum"),
+        z.zmap(lambda x: x + 1.0, name="s2"),
+        z.map_accum(lambda s, x: (s + 1.0, x + s), 0.0, name="ctr"),
+    ]
+    comp = z.par_pipe(*stages)
+    pp = lower_stage_parallel(comp, mesh, width=4, batch_axis="dp")
+
+    B, M, rem_items = 4, 5, 7
+    rng = np.random.default_rng(3)
+    bulk = rng.normal(size=(B, M, pp.take)).astype(np.float32)
+    rems = rng.normal(size=(B, rem_items)).astype(np.float32)
+
+    ys, carries = pp.run_carry(shard_batch(mesh, bulk, axis="dp"))
+    ys = np.asarray(ys)
+    assert isinstance(carries, list) and len(carries) == B
+
+    fused = z.pipe(*stages)
+    for b in range(B):
+        tail, _ = run_jit_carry(fused, rems[b], carry=carries[b])
+        got = np.concatenate([ys[b].reshape(-1), np.asarray(tail)])
+        want = run_jit(fused, np.concatenate(
+            [bulk[b].reshape(-1), rems[b]]))
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5,
+                                   atol=1e-5, err_msg=f"stream {b}")

@@ -50,7 +50,7 @@ def main() -> int:
 
     rng = np.random.default_rng(42)
     psdu = rng.integers(0, 256, 90).astype(np.uint8)
-    frame = np.asarray(tx.encode_frame(psdu, 54))
+    frame = np.asarray(tx.encode_frame(psdu, 54, add_fcs=True))
     x = np.concatenate([
         rng.normal(scale=0.02, size=(60, 2)).astype(np.float32),
         np.asarray(channel.apply_cfo(jnp.asarray(frame), 0.002)),

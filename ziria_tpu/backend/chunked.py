@@ -62,6 +62,11 @@ UNROLL_N = 16
 # input window capacity (items) — fixed so one compile serves every
 # frame length; raised per-node to cover one iteration's worst-case take
 CHUNK_CAP = 4096
+# emitting While loops: output-buffer budget (items) shared between the
+# per-iteration emission bound and the per-chunk iteration cap — the
+# step runs at most out_cap//emit_b iterations per call so emissions
+# can never overflow the buffer (VERDICT r3 next #7)
+WHILE_OUT_ITEMS = 65536
 
 
 class _Unstageable(Exception):
@@ -616,12 +621,12 @@ class _ChunkLoop(ir.Comp):
     # ---------------------------------------------------- jit step
 
     def _get_fn(self, struct, names, take_b: int, out_cap: int,
-                is_for: bool, var):
+                is_for: bool, var, iter_cap: int = 0):
         import jax
         import jax.numpy as jnp
         from ziria_tpu.backend.hybrid import _env_rebuild
 
-        key = (struct, tuple(names), take_b, out_cap, is_for)
+        key = (struct, tuple(names), take_b, out_cap, is_for, iter_cap)
         fn = self._fns.get(key)
         if fn is not None:
             return key, fn
@@ -668,6 +673,10 @@ class _ChunkLoop(ir.Comp):
                     return jnp.logical_and(it < n, fits)
                 put(rvals)
                 c = jnp.asarray(ir.eval_expr(cond, env), bool)
+                if iter_cap:
+                    # emitting While: stop before the output buffer
+                    # can overflow; the host flushes and re-enters
+                    c = jnp.logical_and(c, it - it0 < iter_cap)
                 return jnp.logical_and(c, fits)
 
             def body_fn(carry):
@@ -730,15 +739,27 @@ class _ChunkLoop(ir.Comp):
             else:
                 n = 0
                 if emit_b:
-                    raise _Unstageable("emitting While not chunkable "
-                                       "(no per-chunk emission bound)")
-                out_cap = 0
+                    # bound emissions per chunk by capping iterations:
+                    # the step stops after iter_cap body iterations (or
+                    # when the condition/input guard stops it), reports
+                    # its counts, and the host re-enters — a
+                    # detect-then-emit While runs fully chunked
+                    iter_cap = WHILE_OUT_ITEMS // emit_b
+                    if iter_cap < 1:
+                        raise _Unstageable("while emission bound "
+                                           "exceeds the output budget")
+                    iter_cap = min(iter_cap, 2048)
+                    out_cap = _bucket(emit_b * iter_cap)
+                else:
+                    out_cap = 0
         except _Unstageable:
             return (yield from fallback())
 
         import jax.numpy as jnp
         from ziria_tpu.backend.hybrid import _env_signature
 
+        if is_for or not emit_b:
+            iter_cap = 0
         cap = max(CHUNK_CAP, _bucket(take_b)) if take_b else 0
         if is_for and take_b:
             cap = min(cap, _bucket(max(1, n * take_b)))
@@ -757,7 +778,8 @@ class _ChunkLoop(ir.Comp):
                         m for m in sorted(free_vars(ast))
                         if m not in names and _resolves_ref(env, m)]
             key, _ = self._get_fn(struct, names, take_b, out_cap,
-                                  is_for, orig.var if is_for else None)
+                                  is_for, orig.var if is_for else None,
+                                  iter_cap)
         except _Unstageable:
             return (yield from fallback())
 

@@ -21,6 +21,12 @@ multi-process it uses ``create_hybrid_device_mesh`` with the dp axis
 on the DCN dimension. The same (dp, pp) mesh then drives
 ``parallel.batch`` and ``parallel.stages`` unchanged — the collectives
 are inserted by XLA from the shardings, never hand-written.
+
+.. warning:: EXPERIMENTAL (VERDICT r3 weak #8): the multi-process
+   bring-up path has only ever executed in simulation
+   (tests/test_multihost.py fakes the process set); no real
+   multi-host job has run for lack of hardware. The single-process
+   mesh-construction path is exercised everywhere.
 """
 
 from __future__ import annotations

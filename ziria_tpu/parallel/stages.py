@@ -245,13 +245,19 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
     else:
         # each dp row holds its local shard of streams; vmap runs the
         # pipeline per stream (the pp collectives batch under vmap).
-        # Exit carries are not exposed on the batched path (each stream
-        # would need its own remainder continuation; pad upstream).
+        # Exit carries ARE exposed, one per stream (leading batch axis
+        # on every carry leaf): the bubble masking already keeps them
+        # exact, so each stream can hand its own remainder to the
+        # single-device continuation (VERDICT r3 next #6).
         spec_in = P(batch_axis)
-        spec_out = P(batch_axis, *([None] * (len(out_struct.shape) + 1)))
+        carry_specs = jax.tree_util.tree_map(
+            lambda _: P(batch_axis), init_carries)
+        spec_out = (P(batch_axis, *([None] *
+                                    (len(out_struct.shape) + 1))),
+                    carry_specs)
 
         def spmd(xs_b):
-            return jax.vmap(spmd_one)(xs_b)[0]
+            return jax.vmap(spmd_one)(xs_b)
 
     mapped = shard_map(spmd, mesh=mesh, in_specs=spec_in,
                        out_specs=spec_out, check_vma=False)
@@ -267,7 +273,7 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
             xs = jnp.concatenate(
                 [xs, jnp.zeros(pad_shape, xs.dtype)], axis=t_axis)
         out = jitted(xs)
-        ys, carries = out if batch_axis is None else (out, None)
+        ys, carries = out
         if K > 1:
             ys = ys[K - 1:] if batch_axis is None else ys[:, K - 1:]
         return ys, carries
@@ -277,13 +283,19 @@ def lower_stage_parallel(comp: ir.Comp, mesh: Mesh, axis: str = "pp",
 
     def run_carry(xs):
         """(ys, carry) — carry is a run_jit_carry-compatible dict whose
-        "stages" tuple follows lower(pipe(*segments))'s stage order."""
+        "stages" tuple follows lower(pipe(*segments))'s stage order.
+        On the batched (dp x pp) path, a LIST of such dicts, one per
+        stream (row of xs)."""
         from itertools import chain
         ys, carries = _call(xs)
-        if carries is None:
-            raise LowerError("run_carry is unavailable on the batched "
-                             "(dp x pp) path")
-        return ys, {"stages": tuple(chain.from_iterable(carries))}
+        if batch_axis is None:
+            return ys, {"stages": tuple(chain.from_iterable(carries))}
+        per_stream = []
+        for b in range(int(ys.shape[0])):
+            cb = jax.tree_util.tree_map(lambda x, b=b: x[b], carries)
+            per_stream.append(
+                {"stages": tuple(chain.from_iterable(cb))})
+        return ys, per_stream
 
     return PPLowered(run=run, run_carry=run_carry, take=lows[0].take,
                      emit=lows[-1].emit, n_stages=K,

@@ -297,10 +297,14 @@ def stream_parallel_batched(comp: ir.Comp, batch, mesh: Mesh,
     `advance` (frame-independent analytic fast-forward), or finite
     `memory` — whose entry state is seeded per (frame, shard) by a
     warmup scan over that FRAME's own preceding items, host-side.
-    Streams must divide exactly: frames % dp == 0 and per-frame
-    iterations must align to sp x width — batched decode is a planned
-    layout, not a ragged one (pad upstream), unlike the single-stream
-    path's host tail.
+    Frames must divide over dp (frames % dp == 0); per-frame length
+    may be RAGGED relative to sp x width — the sp*width-aligned bulk
+    runs on the 2-D mesh and the remaining iterations finish per
+    frame with the single-stream path's carry-seeded host tail
+    (VERDICT r3 next #6; the reference's queues had no length
+    restriction, SURVEY.md §2.2). Items beyond a whole steady-state
+    iteration (N % take) are never consumed, matching the lowered
+    semantics everywhere else.
     """
     n_dp = mesh.shape[dp_axis]
     n_sp = mesh.shape[sp_axis]
@@ -313,22 +317,29 @@ def stream_parallel_batched(comp: ir.Comp, batch, mesh: Mesh,
                              f"{n_dp} dp devices")
     big = lower(comp, width=width)
     n_iters = N // big.ss.take
-    share = n_iters // n_sp
-    if share == 0:
+    if n_iters == 0:
         raise StreamParError(
-            f"{n_iters} steady-state iterations cannot split over "
-            f"{n_sp} sp devices")
+            f"{N} items are fewer than one steady-state take "
+            f"({big.ss.take})")
+    share = n_iters // n_sp
     if 0 < share < big.width:
         big = lower(comp, width=share)
     per = share // big.width * big.width
-    if per != share or n_iters != share * n_sp \
-            or n_iters * big.ss.take != N:
-        raise StreamParError(
-            f"stream of {N} items must be exactly sp*width-aligned "
-            f"({n_sp} x {big.width} x take {big.ss.take}); pad "
-            f"upstream")
+    done_iters = n_sp * per
 
     stages, advances, warm_iters = _stage_plan(comp, big)
+    stateful = any(jax.tree_util.tree_leaves(c0)
+                   for c0 in big.init_carry)
+    if per == 0:
+        # too short to shard over sp: every frame runs as a plain
+        # carry-seeded host run (still exact, still one code path)
+        from ziria_tpu.backend.execute import run_jit_carry
+        outs = []
+        for f in range(B):
+            t, _ = run_jit_carry(
+                comp, batch[f, : n_iters * big.ss.take], width=width)
+            outs.append(np.asarray(t))
+        return np.stack(outs)
     # memory-stage warmup runs ON DEVICE when the warm window fits in
     # a neighbor's shard: each frame's sp-shard tail ppermutes
     # rightward inside the shard_map and seeds the next shard's entry
@@ -368,9 +379,10 @@ def stream_parallel_batched(comp: ir.Comp, batch, mesh: Mesh,
 
     steps = per // big.width
     scan = big.scan_steps()
-    # (B, N, ...) -> (dp, B/dp, sp, steps, take, ...)
-    shaped = batch.reshape((n_dp, B // n_dp, n_sp, steps, big.take)
-                           + batch.shape[2:])
+    # aligned bulk: (B, done*take, ...) -> (dp, B/dp, sp, steps, take, ..)
+    bulk = batch[:, : done_iters * big.ss.take]
+    shaped = bulk.reshape((n_dp, B // n_dp, n_sp, steps, big.take)
+                          + batch.shape[2:])
     shaped = jnp.asarray(shaped)
 
     def shard_body(carry_stack, chunks):
@@ -410,6 +422,22 @@ def stream_parallel_batched(comp: ir.Comp, batch, mesh: Mesh,
         ys = np.asarray(run2(carries, shaped))
     # (dp, B/dp, sp, steps, emit, ...) -> (B, sp*steps*emit, ...)
     ys = ys.reshape((B, n_sp * steps * big.emit) + ys.shape[5:])
+
+    if done_iters < n_iters:
+        # ragged tail: the iterations past the sp*width-aligned bulk
+        # finish per frame on the host path, carry-seeded at the bulk
+        # boundary — identical machinery to the single-stream tail
+        from ziria_tpu.backend.execute import run_jit_carry
+        carry_fn = _entry_carry_fn(comp, big, stages, advances,
+                                   warm_iters)
+        tails = []
+        for f in range(B):
+            rem = batch[f, done_iters * big.ss.take:
+                        n_iters * big.ss.take]
+            tc = carry_fn(done_iters, batch[f]) if stateful else None
+            t, _ = run_jit_carry(comp, rem, carry=tc, width=width)
+            tails.append(np.asarray(t))
+        ys = np.concatenate([ys, np.stack(tails)], axis=1)
     return ys
 
 

@@ -67,7 +67,7 @@ def multipath(samples, taps_pair) -> jnp.ndarray:
 def impaired_capture(mbps: int, n_bytes: int, seed: int,
                      cfo: float = 0.002, pre: int = 60, post: int = 40,
                      noise: float = 0.03, floor: float = 0.02,
-                     scale: float = 1024.0):
+                     scale: float = 1024.0, add_fcs: bool = False):
     """A deterministic receiver test vector: one TX frame with CFO,
     surrounded by noise, plus AWGN, quantized to the complex16 wire
     format (int16 IQ pairs). Returns (psdu_bytes, samples).
@@ -82,7 +82,7 @@ def impaired_capture(mbps: int, n_bytes: int, seed: int,
 
     rng = np.random.default_rng(seed)
     psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
-    frame = np.asarray(tx.encode_frame(psdu, mbps))
+    frame = np.asarray(tx.encode_frame(psdu, mbps, add_fcs=add_fcs))
     x = np.concatenate([
         rng.normal(scale=floor, size=(pre, 2)).astype(np.float32),
         np.asarray(apply_cfo(jnp.asarray(frame), cfo)),
