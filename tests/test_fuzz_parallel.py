@@ -134,6 +134,10 @@ def test_fuzz_dp_x_sp_equals_per_frame(seed):
     # pool), frames % dp == 0
     B = int(rng.choice([2, 4]))
     N = 4 * width * int(rng.integers(1, 5))
+    if rng.random() < 0.5:
+        # ragged (r4): lengths off the sp*width grid exercise the
+        # bulk + per-frame carry-seeded host tail split
+        N += int(rng.integers(1, 4 * width))
     batch = rng.integers(-1000, 1000, size=(B, N)).astype(np.int32)
     got = stream_parallel_batched(prog, batch, mesh, width=width)
     for f in range(B):
@@ -200,3 +204,37 @@ def test_fuzz_chunked_loops_equal_oracle(seed):
         np.asarray(want.out_array()), np.asarray(got.out_array()),
         err_msg=f"seed {seed}\n{src}")
     assert want.terminated_by == got.terminated_by, f"seed {seed}"
+
+
+# ------------------------------------------------------------ framebatch
+
+
+N_FRAMEBATCH = 10
+
+
+@pytest.mark.parametrize("seed", range(N_FRAMEBATCH))
+def test_fuzz_framebatch_equals_per_frame(seed):
+    """Random chunked-machine programs over random RAGGED frame sets:
+    run_many (threads + shared StepBatcher + vmapped steps) must be
+    bit-identical to running every frame alone — the seam where lane
+    masking, regrouping, pushback, and interpreter tails all meet."""
+    from ziria_tpu.backend import hybrid as H
+    from ziria_tpu.backend.framebatch import StepBatcher, run_many
+    from ziria_tpu.frontend import compile_source
+    from ziria_tpu.interp.interp import run
+
+    rng = np.random.default_rng(6000 + seed)
+    src, _xs = _gen_chunk_program(rng)
+    hyb = H.hybridize(compile_source(src).comp)
+    n_frames = int(rng.integers(2, 7))
+    frames = [rng.integers(-500, 500,
+                           size=int(rng.integers(30, 400))).astype(
+                               np.int32)
+              for _ in range(n_frames)]
+    want = [run(hyb, list(f)) for f in frames]
+    got = run_many(hyb, frames, batcher=StepBatcher(n_frames))
+    for k, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(
+            np.asarray(w.out_array()), np.asarray(g.out_array()),
+            err_msg=f"seed {seed} frame {k}\n{src}")
+        assert w.terminated_by == g.terminated_by, f"seed {seed}:{k}"
