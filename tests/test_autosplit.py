@@ -117,3 +117,71 @@ def test_cli_auto_pp(tmp_path):
         assert rc == 0
         outs[label] = outf.read_text()
     assert outs["plain"] == outs["pp"]
+
+
+def test_auto_pipeline_measured_costs_shift_partition():
+    # ROADMAP r4 §4: measured wall-time costs replace the items-moved
+    # proxy. Four same-rate stages (proxy sees them equal) where one
+    # does ~100x the arithmetic: the measured 2-way cut must isolate
+    # the heavy stage's side, not split 2+2 blindly
+    import ziria_tpu as z
+    from ziria_tpu.parallel.autosplit import (_flatten, auto_pipeline,
+                                              measured_stage_costs)
+
+    def heavy(x):
+        y = x
+        for _ in range(120):
+            y = (y * 1664525 + 1013904223) % 2147483647
+        return y
+
+    stages = [
+        z.zmap(lambda x: x + 1, name="s0"),
+        z.zmap(heavy, name="heavy"),
+        z.zmap(lambda x: x * 3, name="s2"),
+        z.zmap(lambda x: x - 2, name="s3"),
+    ]
+    prog = z.pipe(*stages)
+    xs = np.arange(1 << 12, dtype=np.int32)
+    costs = measured_stage_costs(_flatten(prog), xs, width=8)
+    assert len(costs) == 4
+    assert costs[1] == max(costs)
+
+    out = auto_pipeline(prog, 2, sample=xs, width=8)
+    from ziria_tpu.core import ir
+    segs = ir.par_segments(out)
+    assert len(segs) == 2
+    # the heavy stage must NOT share a segment with both neighbors:
+    # a 2-way cut lands at [s0 | heavy..] or [s0 heavy | ..]
+    labels = [[s.label() for s in
+               (_flatten(seg))] for seg in segs]
+    heavy_seg = 0 if any("heavy" in l for l in labels[0]) else 1
+    assert len(labels[heavy_seg]) <= 2
+
+
+def test_cli_auto_pp_measured(tmp_path):
+    from ziria_tpu.runtime.cli import main as cli_main
+    src = tmp_path / "chain.zir"
+    src.write_text("""
+      fun f1(x: int32) : int32 { return x * 2 }
+      fun f2(x: int32) : int32 { return x + 7 }
+      fun f3(x: int32) : int32 { return x ^ 21 }
+      fun f4(x: int32) : int32 { return x - 3 }
+      let comp main = read[int32] >>> map f1 >>> map f2 >>> map f3
+                      >>> map f4 >>> write[int32]
+    """)
+    inf = tmp_path / "in.dbg"
+    xs = np.arange(4 * 2048, dtype=np.int32)
+    inf.write_text(",".join(map(str, xs)))
+    outs = {}
+    for label, extra in (("plain", []),
+                         ("pp", ["--pp=4", "--pp-costs=measured"])):
+        outf = tmp_path / f"{label}.dbg"
+        rc = cli_main([
+            f"--src={src}", "--input=file", f"--input-file-name={inf}",
+            "--input-file-mode=dbg", "--output=file",
+            f"--output-file-name={outf}", "--output-file-mode=dbg",
+            "--width=8",
+        ] + extra)
+        assert rc == 0
+        outs[label] = outf.read_text()
+    assert outs["plain"] == outs["pp"]

@@ -88,12 +88,58 @@ def balanced_partition(costs: Sequence[float], k: int) -> List[int]:
     return cuts
 
 
+def measured_stage_costs(flat: Sequence[ir.Comp], sample,
+                         width: Optional[int] = None) -> List[float]:
+    """Wall-time each leaf stage on a sample of the REAL input (one
+    warm pass to absorb compilation, one timed), cascading each
+    stage's output into the next — the measured replacement for the
+    items-moved proxy (`--pp-costs=measured`; ROADMAP r4 §4). Dynamic
+    stages time under the hybrid executor, mirroring the `--profile`
+    breakdown's discipline."""
+    import time as _time
+
+    import numpy as np
+
+    costs: List[float] = []
+    cur = np.asarray(sample)
+    for st in flat:
+        from ziria_tpu.backend.execute import run_jit_carry
+        from ziria_tpu.backend.lower import LowerError, lower
+
+        try:
+            lower(st, width=width)            # plan only (cheap)
+
+            def go(_st=st, _cur=cur):
+                ys, _ = run_jit_carry(_st, _cur, width=width)
+                return np.asarray(ys)
+        except LowerError:
+            from ziria_tpu.backend.hybrid import hybridize
+            from ziria_tpu.interp.interp import run as _irun
+            hyb = hybridize(st)
+
+            def go(_st=hyb, _cur=cur):
+                return np.asarray(_irun(_st, list(_cur)).out_array())
+
+        go()                                  # warm-up / compile
+        t0 = _time.perf_counter()
+        out = go()
+        costs.append(max(_time.perf_counter() - t0, 1e-9))
+        cur = out
+    return costs
+
+
 def auto_pipeline(comp: ir.Comp, n_segments: int,
-                  cost_fn: Optional[Callable] = None) -> ir.Comp:
+                  cost_fn: Optional[Callable] = None,
+                  sample=None,
+                  width: Optional[int] = None) -> ir.Comp:
     """Rewrite `comp` (a static-rate `>>>` pipeline) into `n_segments`
     ParPipe segments with balanced estimated cost. Existing ParPipe
     annotations are flattened and re-decided — this IS the decision
-    pass. Returns the annotated comp for `lower_stage_parallel`."""
+    pass. Returns the annotated comp for `lower_stage_parallel`.
+
+    Costs come from (highest priority first): `sample` — measured
+    per-stage wall time over that input sample; `cost_fn(stage, reps)`;
+    or the items-moved proxy."""
     flat = _flatten(comp)
     if n_segments < 1:
         raise AutoSplitError("need at least one segment")
@@ -106,8 +152,11 @@ def auto_pipeline(comp: ir.Comp, n_segments: int,
         raise AutoSplitError(
             "auto-pipelining needs a static steady state; dynamic "
             "pipelines run on the hybrid executor instead")
-    fn = cost_fn or default_stage_cost
-    costs = [fn(s, r) for s, r in zip(flat, ss.reps)]
+    if sample is not None:
+        costs = measured_stage_costs(flat, sample, width=width)
+    else:
+        fn = cost_fn or default_stage_cost
+        costs = [fn(s, r) for s, r in zip(flat, ss.reps)]
     cuts = [0] + balanced_partition(costs, n_segments) + [len(flat)]
     groups = []
     for a, b in zip(cuts[:-1], cuts[1:]):

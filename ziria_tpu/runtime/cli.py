@@ -158,6 +158,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="auto-pipeline the stages across N devices "
                         "(balanced |>>>| placement decided by the "
                         "compiler; jit backend)")
+    p.add_argument("--pp-costs", choices=("proxy", "measured"),
+                   default="proxy",
+                   help="stage-cost model for --pp placement: 'proxy' "
+                        "(items moved per steady-state iteration) or "
+                        "'measured' (time each stage on a sample of "
+                        "the real input before deciding)")
     p.add_argument("--fold", action="store_true", default=True)
     p.add_argument("--no-fold", dest="fold", action="store_false")
     p.add_argument("--autolut", action="store_true")
@@ -313,8 +319,18 @@ def main(argv=None) -> int:
         # |>>>| annotations are flattened onto the single device
         from ziria_tpu.parallel.autosplit import (AutoSplitError,
                                                   auto_pipeline)
+        sample = None
+        if args.pp_costs == "measured":
+            # time each stage on (a slice of) the real input instead
+            # of the items-moved proxy; the stream re-reads below
+            spec = StreamSpec(kind=args.input, ty=in_ty,
+                              path=args.input_file_name,
+                              mode=args.input_file_mode,
+                              dummy_items=args.dummy_samples)
+            sample = read_stream(spec)[: 1 << 15]
         try:
-            comp = auto_pipeline(comp, args.pp)
+            comp = auto_pipeline(comp, args.pp, sample=sample,
+                                 width=args.width or 1)
         except AutoSplitError as e:
             raise SystemExit(f"--pp={args.pp}: {e}")
     if args.fold:
