@@ -212,3 +212,47 @@ def test_cli_profile_trace(tmp_path):
     ])
     assert rc == 0
     assert tdir.exists() and any(tdir.rglob("*"))
+
+
+def test_cli_batch_input_files(tmp_path):
+    """--batch-input-files: N captures decode in one process with
+    frame-batched device calls, each output equal to its own solo
+    run (the driver surface of backend/framebatch)."""
+    src = os.path.join(EXAMPLES, "scrambler.zir")
+    rng = np.random.default_rng(5)
+    ins, outs, solo = [], [], []
+    for k in range(4):
+        xs = rng.integers(0, 2, 256 + 32 * k).astype(np.uint8)
+        inf = tmp_path / f"in{k}.dbg"
+        write_stream(StreamSpec(ty="bit", path=str(inf), mode="dbg"),
+                     xs)
+        ins.append(str(inf))
+        outs.append(str(tmp_path / f"out{k}.dbg"))
+        sof = tmp_path / f"solo{k}.dbg"
+        rc = cli_main([
+            f"--src={src}", "--input=file",
+            f"--input-file-name={inf}", "--input-file-mode=dbg",
+            "--output=file", f"--output-file-name={sof}",
+            "--output-file-mode=dbg", "--backend=hybrid"])
+        assert rc == 0
+        solo.append(sof.read_text())
+    rc = cli_main([
+        f"--src={src}",
+        f"--batch-input-files={','.join(ins)}",
+        f"--batch-output-files={','.join(outs)}",
+        "--input-file-mode=dbg", "--output-file-mode=dbg"])
+    assert rc == 0
+    for k, out in enumerate(outs):
+        assert open(out).read() == solo[k], f"stream {k}"
+
+
+def test_cli_batch_validation(tmp_path):
+    src = os.path.join(EXAMPLES, "scrambler.zir")
+    with pytest.raises(SystemExit, match="together"):
+        cli_main([f"--src={src}", "--batch-input-files=a,b"])
+    with pytest.raises(SystemExit, match="2 inputs but 1"):
+        cli_main([f"--src={src}", "--batch-input-files=a,b",
+                  "--batch-output-files=c"])
+    with pytest.raises(SystemExit, match="cannot combine"):
+        cli_main([f"--src={src}", "--batch-input-files=a",
+                  "--batch-output-files=c", "--sp=4"])

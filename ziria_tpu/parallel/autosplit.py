@@ -88,14 +88,40 @@ def balanced_partition(costs: Sequence[float], k: int) -> List[int]:
     return cuts
 
 
+def stage_runner(st: ir.Comp, cur, width: Optional[int] = None):
+    """A zero-arg callable running ONE stage over `cur`: the fused jit
+    path when the stage lowers, else the hybrid executor (hybridized
+    ONCE so a warm-up call actually warms the _JitDo caches and a
+    later timed call measures execution, not recompilation). Shared by
+    the `--profile` breakdown and `measured_stage_costs` so the
+    stage-timing discipline cannot drift between them."""
+    import numpy as np
+
+    from ziria_tpu.backend.execute import run_jit_carry
+    from ziria_tpu.backend.lower import LowerError, lower
+
+    try:
+        lower(st, width=width)                # plan only (cheap)
+
+        def go(_st=st, _cur=cur):
+            ys, _ = run_jit_carry(_st, _cur, width=width)
+            return np.asarray(ys)
+    except LowerError:
+        from ziria_tpu.backend.hybrid import hybridize
+        from ziria_tpu.interp.interp import run as _irun
+        hyb = hybridize(st)
+
+        def go(_st=hyb, _cur=cur):
+            return np.asarray(_irun(_st, list(_cur)).out_array())
+    return go
+
+
 def measured_stage_costs(flat: Sequence[ir.Comp], sample,
                          width: Optional[int] = None) -> List[float]:
     """Wall-time each leaf stage on a sample of the REAL input (one
     warm pass to absorb compilation, one timed), cascading each
     stage's output into the next — the measured replacement for the
-    items-moved proxy (`--pp-costs=measured`; ROADMAP r4 §4). Dynamic
-    stages time under the hybrid executor, mirroring the `--profile`
-    breakdown's discipline."""
+    items-moved proxy (`--pp-costs=measured`; ROADMAP r4 §4)."""
     import time as _time
 
     import numpy as np
@@ -103,23 +129,14 @@ def measured_stage_costs(flat: Sequence[ir.Comp], sample,
     costs: List[float] = []
     cur = np.asarray(sample)
     for st in flat:
-        from ziria_tpu.backend.execute import run_jit_carry
-        from ziria_tpu.backend.lower import LowerError, lower
-
-        try:
-            lower(st, width=width)            # plan only (cheap)
-
-            def go(_st=st, _cur=cur):
-                ys, _ = run_jit_carry(_st, _cur, width=width)
-                return np.asarray(ys)
-        except LowerError:
-            from ziria_tpu.backend.hybrid import hybridize
-            from ziria_tpu.interp.interp import run as _irun
-            hyb = hybridize(st)
-
-            def go(_st=hyb, _cur=cur):
-                return np.asarray(_irun(_st, list(_cur)).out_array())
-
+        if cur.shape[0] == 0:
+            # an empty cascade would time every remaining stage on
+            # nothing and report noise as a "measured" partition
+            raise AutoSplitError(
+                f"measured costs need a non-empty sample at every "
+                f"stage; stage {st.label()} received 0 items (sample "
+                f"too short for the upstream take rates?)")
+        go = stage_runner(st, cur, width=width)
         go()                                  # warm-up / compile
         t0 = _time.perf_counter()
         out = go()

@@ -146,6 +146,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="item type (default: from the program's write[t], "
                         "else int32)")
 
+    p.add_argument("--batch-input-files", metavar="F1,F2,...",
+                   help="decode N independent input streams in ONE "
+                        "process, batching the compiled program's "
+                        "device steps across them (backend/framebatch; "
+                        "implies --backend=hybrid); pairs with "
+                        "--batch-output-files")
+    p.add_argument("--batch-output-files", metavar="F1,F2,...",
+                   help="per-stream output files for "
+                        "--batch-input-files (same count)")
+
     p.add_argument("--backend", default="jit",
                    choices=["interp", "jit", "hybrid"])
     p.add_argument("--width", type=int, default=None,
@@ -257,25 +267,11 @@ def _run_profiled(comp, xs, args):
             def go(_st=st, _cur=cur):
                 return np.asarray(run(_st, list(_cur)).out_array())
         else:
-            from ziria_tpu.backend.execute import run_jit_carry
-            from ziria_tpu.backend.lower import LowerError, lower
-            try:
-                lower(st, width=args.width)     # plan only (cheap)
-
-                def go(_st=st, _cur=cur):
-                    ys, _ = run_jit_carry(_st, _cur, width=args.width)
-                    return np.asarray(ys)
-            except LowerError:
-                # dynamic stage: profile it under the hybrid executor
-                # instead of crashing the breakdown. Hybridize ONCE so
-                # the warm-up pass actually warms the _JitDo caches and
-                # the timed pass measures execution, not recompilation.
-                from ziria_tpu.backend.hybrid import hybridize
-                from ziria_tpu.interp.interp import run
-                hyb = hybridize(st)
-
-                def go(_st=hyb, _cur=cur):
-                    return np.asarray(run(_st, list(_cur)).out_array())
+            # jit when the stage lowers, hybrid otherwise — the shared
+            # stage-timing discipline (autosplit.stage_runner, also
+            # behind --pp-costs=measured)
+            from ziria_tpu.parallel.autosplit import stage_runner
+            go = stage_runner(st, cur, width=args.width)
 
         go()                                   # warm-up / compile
         t0 = time.perf_counter()
@@ -306,6 +302,7 @@ def main(argv=None) -> int:
     in_ty = args.input_type or src_in_ty or "int32"
     out_ty = args.output_type or src_out_ty or "int32"
 
+    pre_read = None      # input parsed early by --pp-costs=measured
     # autolut first: fold's map-map fusion erases in_domain declarations,
     # so the LUT rewrite must see the maps before they fuse
     if args.autolut:
@@ -321,13 +318,23 @@ def main(argv=None) -> int:
                                                   auto_pipeline)
         sample = None
         if args.pp_costs == "measured":
+            # validate flag compatibility BEFORE spending seconds of
+            # per-stage sampling that _run_backend would reject anyway
+            if args.backend != "jit" or args.profile:
+                raise SystemExit("--pp needs --backend=jit and cannot "
+                                 "combine with --profile")
             # time each stage on (a slice of) the real input instead
-            # of the items-moved proxy; the stream re-reads below
+            # of the items-moved proxy; the full array is kept so the
+            # run below does not parse the file a second time
             spec = StreamSpec(kind=args.input, ty=in_ty,
                               path=args.input_file_name,
                               mode=args.input_file_mode,
                               dummy_items=args.dummy_samples)
-            sample = read_stream(spec)[: 1 << 15]
+            pre_read = read_stream(spec)
+            if pre_read.shape[0] == 0:
+                raise SystemExit("--pp-costs=measured: input sample is "
+                                 "empty (nothing to time)")
+            sample = pre_read[: 1 << 15]
         try:
             comp = auto_pipeline(comp, args.pp, sample=sample,
                                  width=args.width or 1)
@@ -346,6 +353,9 @@ def main(argv=None) -> int:
         print("hybrid plan:", file=sys.stderr)
         hybridize(comp, dump=lambda s: print(s, file=sys.stderr))
 
+    if args.batch_input_files or args.batch_output_files:
+        return _run_batch_files(comp, args, in_ty, out_ty)
+
     in_spec = StreamSpec(kind=args.input, ty=in_ty,
                          path=args.input_file_name,
                          mode=args.input_file_mode,
@@ -357,7 +367,7 @@ def main(argv=None) -> int:
     if args.profile and (args.state_in or args.state_out):
         raise SystemExit("--profile runs stages separately and "
                          "cannot combine with --state-in/--state-out")
-    xs = read_stream(in_spec)
+    xs = pre_read if pre_read is not None else read_stream(in_spec)
     tracing = False
     if args.profile_trace:
         import jax
@@ -444,6 +454,54 @@ def _run_auto_pp(comp, xs, args, t0):
     ys = (np.concatenate(outs, axis=0) if outs
           else np.empty((0,) + xs.shape[1:], xs.dtype))
     return ys, time.perf_counter() - t0
+
+
+def _run_batch_files(comp, args, in_ty, out_ty) -> int:
+    """--batch-input-files: N independent streams through one
+    hybridized program, chunk-machine device steps batched across them
+    (backend/framebatch.py) — the driver surface of frame batching.
+    Each stream's output goes to the matching --batch-output-files
+    entry, bit-identical to N separate runs."""
+    if not (args.batch_input_files and args.batch_output_files):
+        raise SystemExit("--batch-input-files and --batch-output-files "
+                         "must be given together")
+    ins = [f for f in args.batch_input_files.split(",") if f]
+    outs = [f for f in args.batch_output_files.split(",") if f]
+    if len(ins) != len(outs):
+        raise SystemExit(
+            f"--batch-*: {len(ins)} inputs but {len(outs)} outputs")
+    if args.backend == "jit":
+        args.backend = "hybrid"           # the documented implication
+    if args.backend != "hybrid" or args.profile or args.profile_trace \
+            or args.stats or args.sp is not None \
+            or args.pp is not None or args.state_in or args.state_out:
+        raise SystemExit("--batch-input-files runs the hybrid backend "
+                         "and cannot combine with --sp/--pp/--profile/"
+                         "--profile-trace/--stats/--state-*")
+
+    from ziria_tpu.backend.framebatch import StepBatcher, run_many
+    from ziria_tpu.backend.hybrid import hybridize
+
+    frames = [read_stream(StreamSpec(kind="file", ty=in_ty, path=f,
+                                     mode=args.input_file_mode))
+              for f in ins]
+    hyb = hybridize(comp)
+    t0 = time.perf_counter()
+    b = StepBatcher(len(frames))
+    results = run_many(hyb, [list(x) for x in frames], batcher=b)
+    dt = time.perf_counter() - t0
+    for f, res in zip(outs, results):
+        write_stream(StreamSpec(kind="file", ty=out_ty, path=f,
+                                mode=args.output_file_mode),
+                     np.asarray(res.out_array()))
+    if args.verbose:
+        n_in = sum(x.shape[0] for x in frames)
+        n_out = sum(len(r.outputs) for r in results)
+        print(f"batch: {len(frames)} streams, items in: {n_in}, "
+              f"items out: {n_out}, device calls: {b.device_calls} "
+              f"(group sizes {b.group_sizes}), time: {dt:.4f}s",
+              file=sys.stderr)
+    return 0
 
 
 def _run_backend(comp, xs, args, t0):
