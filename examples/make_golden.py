@@ -63,6 +63,8 @@ CASES = [
     ("depuncture_23", "float32", lambda: _llrs(192, 111), "dbg"),
     ("depuncture_34", "float32", lambda: _llrs(192, 112), "bin"),
     ("pilot_track", "complex16", lambda: _iq(52 * 6, 113), "dbg"),
+    # RX front-end DC removal (reference receiver's first block)
+    ("dc_remove", "complex16", lambda: _iq_dc(512, 120), "dbg"),
     # stdlib (v_* / crc32) examples — VERDICT r1 #8
     ("crc_frame", "bit", lambda: _bits(512, 114), "bin"),
     ("correlator", "complex16", lambda: _iq(320, 115), "dbg"),
@@ -81,6 +83,14 @@ CASES = [
     # decode all pinned by one file pair
     ("wifi_rx", "complex16", lambda: _rx_capture(24, 60, 119), "bin"),
 ]
+
+
+def _iq_dc(n, seed):
+    # complex16 samples riding a strong DC offset for dc_remove.zir
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 120, (n, 2)) + np.array([310.0, -170.0])
+    return np.clip(np.round(x), -32768, 32767).astype(np.int16)
 
 
 def _rx_capture(mbps, n_bytes, seed):

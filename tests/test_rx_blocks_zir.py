@@ -112,3 +112,28 @@ def test_correlator_matches_numpy():
         want.append(np.abs(s) / (512.0 * 512.0))
     np.testing.assert_allclose(got, np.concatenate(want), rtol=2e-5,
                                atol=1e-4)
+
+
+def test_dc_remove_kills_offset():
+    """dc_remove.zir (reference RX front-end block): a strong DC
+    offset decays with the single-pole IIR's time constant and an
+    oracle numpy recurrence reproduces the stream exactly."""
+    rng = np.random.default_rng(7)
+    x = (rng.normal(0, 120, (1024, 2))
+         + np.array([310.0, -170.0]))
+    x = np.clip(np.round(x), -32768, 32767).astype(np.int16)
+    got = _run_zir("dc_remove", x)
+    got = np.asarray(got)
+
+    # numpy oracle: acc += (x - acc/64); y = x - acc/64
+    acc = np.zeros(2)
+    want = np.empty_like(x, dtype=np.float64)
+    for k in range(x.shape[0]):
+        acc = acc + (x[k] - acc / 64.0)
+        want[k] = x[k] - acc / 64.0
+    # complex16 output quantizes to int16
+    np.testing.assert_array_equal(
+        got, np.clip(np.round(want), -32768, 32767).astype(np.int16))
+    # and the offset is actually gone in the tail
+    tail = got[512:].mean(axis=0)
+    assert np.all(np.abs(tail) < 15), tail
