@@ -174,3 +174,38 @@ def test_wifi_rx_zir_fcs_rejects_corruption():
     # a frame whose TX never appended an FCS is likewise rejected
     _p2, x2 = channel.impaired_capture(24, 60, seed=78, add_fcs=False)
     assert run(hyb, [p for p in x2]).out_array().size == 0
+
+
+def test_wifi_rx_zir_continuous_drops_bad_frame():
+    """Resilience: in a back-to-back stream, a frame corrupted in its
+    DATA region is dropped by the in-language FCS while the frames
+    around it still decode — the receive loop survives a bad frame
+    instead of emitting garbage into the stream."""
+    import re
+
+    from ziria_tpu.backend import hybrid as H
+    from ziria_tpu.frontend import compile_source
+    from ziria_tpu.utils.bits import bytes_to_bits
+
+    src_txt = open(SRC).read()
+    src_txt = re.sub(
+        r"let comp main = read\[complex16\] >>> rx\(\) >>> write\[bit\]",
+        "let comp main = read[complex16] >>> repeat { rx() } "
+        ">>> write[bit]", src_txt)
+    prog = compile_source(src_txt)
+
+    psdu1, x1 = _impaired_capture(24, 60, seed=41)
+    psdu2, x2 = _impaired_capture(36, 70, seed=42)
+    psdu3, x3 = _impaired_capture(54, 90, seed=43)
+    x2 = np.array(x2)
+    # corrupt frame 2's DATA region (pre=60 noise + 320 preamble +
+    # 80 SIGNAL = DATA from sample 460; the header must stay intact so
+    # the receiver consumes exactly this frame's span)
+    x2[520:536] = -x2[520:536]
+    xs = list(np.concatenate([np.asarray(x1), x2, np.asarray(x3)],
+                             axis=0))
+    want = np.concatenate([np.asarray(bytes_to_bits(psdu1)),
+                           np.asarray(bytes_to_bits(psdu3))])
+
+    got_h = run(H.hybridize(prog.comp), xs).out_array()
+    np.testing.assert_array_equal(np.asarray(got_h, np.uint8), want)
