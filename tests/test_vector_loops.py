@@ -621,3 +621,47 @@ def test_vectorized_graph_has_no_while_ops(monkeypatch):
 
     assert count_whiles(no_vec=True) >= 1
     assert count_whiles(no_vec=False) == 0
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_general_induction_rmw_shapes(seed):
+    # richer fuzz shape (r4): conditional general induction `sel`,
+    # gather at the induction's per-lane value, a reduction `tot`,
+    # an affine induction `ph`, strided conditional scatters — all in
+    # one body; soaked over 60 seeds before committing these 6
+    rng = np.random.default_rng(9000 + seed)
+    n = int(rng.choice([24, 48, 96]))
+    stride = int(rng.choice([1, 2, 3]))
+    off = int(rng.integers(0, stride)) if stride > 1 else 0
+    mul = int(rng.integers(1, 7))
+    th = int(rng.integers(0, n))
+    period = int(rng.choice([3, 4, 6]))
+    drop = int(rng.integers(0, period))
+    src = f"""
+    let comp main = read[int32] >>> repeat {{
+      (v : arr[{n}] int32) <- takes {n};
+      var out : arr[{stride * n}] int32;
+      var sel : int32 := 0;
+      var tot : int32 := 0;
+      var ph : int32 := {int(rng.integers(-5, 5))};
+      do {{
+        for k in [0, {n}] {{
+          var keep : int32 := 1;
+          if (k % {period} == {drop}) then {{ keep := 0 }};
+          var t : int32 := v[k] * {mul} + ph;
+          if (k >= {th}) then {{ t := t - v[{n - 1} - k] }}
+          else {{ t := t + 7 }};
+          if (keep == 1) then {{
+            out[{stride} * k + {off}] := t + v[sel % {n}];
+            sel := sel + 1
+          }} else {{ out[{stride} * k + {off}] := 0 - 1 }};
+          tot := tot + v[k] % 13;
+          ph := ph + 1
+        }}
+      }};
+      emits out[0, {stride * n}];
+      emit sel + tot
+    }} >>> write[int32]
+    """
+    xs = rng.integers(-1000, 1000, size=2 * n).astype(np.int32)
+    _both(src, xs)
