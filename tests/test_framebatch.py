@@ -212,3 +212,29 @@ def test_vmap_failure_degrades_to_singles():
         np.testing.assert_array_equal(np.asarray(w.out_array()),
                                       np.asarray(g.out_array()))
     assert max(b2.group_sizes) == len(frames)
+
+
+def test_one_frame_error_surfaces_others_complete():
+    # a genuine program error in ONE frame (the language `error`
+    # builtin, data-triggered — runs interpreter-side since effects
+    # are unstageable) must surface from run_many after the other
+    # frames finish — no deadlock, no silent swallow
+    src = """
+    let comp main = read[int32] >>> {
+      var s : int32 := 0;
+      times 300 {
+        x <- take;
+        do { s := s + x }
+      };
+      if (s < 0) then { do { error "negative checksum" } };
+      emit s
+    } >>> write[int32]
+    """
+    hyb = H.hybridize(compile_source(src).comp)
+    good = [np.arange(300, dtype=np.int32) % 64 for _ in range(3)]
+    bad = np.full(300, -1, np.int32)           # s goes negative
+    with pytest.raises(Exception, match="negative checksum"):
+        run(hyb, list(bad))                    # solo errors too
+    with pytest.raises(Exception, match="negative checksum"):
+        run_many(hyb, good[:1] + [bad] + good[1:],
+                 batcher=StepBatcher(4))
