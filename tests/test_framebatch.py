@@ -238,3 +238,25 @@ def test_one_frame_error_surfaces_others_complete():
     with pytest.raises(Exception, match="negative checksum"):
         run_many(hyb, good[:1] + [bad] + good[1:],
                  batcher=StepBatcher(4))
+
+
+def test_max_out_limit_under_batching():
+    # infinite transformers stop at max_out per frame; a frame whose
+    # generator is abandoned mid-stream must not wedge the batcher
+    src = """
+    let comp main = read[int32] >>> repeat {
+      var s : int32 := 0;
+      times 64 { x <- take; do { s := s + x } };
+      times 64 { emit s; do { s := s - 1 } }
+    } >>> write[int32]
+    """
+    hyb = H.hybridize(compile_source(src).comp)
+    frames = [(np.arange(640, dtype=np.int32) * k) % 101
+              for k in range(1, 5)]
+    want = [run(hyb, list(f), max_out=100) for f in frames]
+    got = run_many(hyb, frames, max_out=100,
+                   batcher=StepBatcher(len(frames)))
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w.out_array()),
+                                      np.asarray(g.out_array()))
+        assert w.terminated_by == g.terminated_by == "limit"
