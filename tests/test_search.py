@@ -51,3 +51,53 @@ def test_noise_only_capture_finds_nothing():
     cap = rng.normal(scale=0.05, size=(4000, 2)).astype(np.float32)
     assert search.find_packets(cap).size == 0
     assert search.find_packets(cap, mesh=stream_mesh(8)).size == 0
+
+
+def test_scan_and_decode_batch():
+    """sp-sharded search + frame-batched decode: every packet in a
+    long capture comes back as validated payload bits; a corrupted
+    packet is dropped by the in-language FCS; decodes ride batched
+    device calls (backend/framebatch)."""
+    from ziria_tpu.phy import channel
+    from ziria_tpu.utils.bits import bytes_to_bits
+
+    rng = np.random.default_rng(3)
+    caps, psdus = [], []
+    for k, (mbps, nb) in enumerate([(12, 40), (24, 60), (6, 30)]):
+        psdu, xi = channel.impaired_capture(
+            mbps, nb, seed=700 + k, cfo=0.001, pre=0, post=0,
+            noise=0.02, add_fcs=True)
+        caps.append(np.asarray(xi))
+        psdus.append(psdu)
+
+    gap = lambda n: np.clip(np.round(rng.normal(
+        scale=20.0, size=(n, 2))), -32768, 32767).astype(np.int16)
+    stream = [gap(900)]
+    offsets = []
+    pos = 900
+    for xi in caps:
+        offsets.append(pos)
+        stream.append(xi)
+        pos += len(xi)
+        stream.append(gap(900))
+        pos += 900
+    capture = np.concatenate(stream, axis=0)
+
+    got = search.scan_and_decode(capture, mesh=stream_mesh(8))
+    assert len(got) == 3, [g[0] for g in got]
+    for (s, bits), off, psdu in zip(got, offsets, psdus):
+        assert off - 64 <= s <= off + 160, (s, off)
+        np.testing.assert_array_equal(bits,
+                                      np.asarray(bytes_to_bits(psdu)))
+
+    # corrupt the middle packet's DATA region: still found, but its
+    # decode is FCS-rejected, so only packets 1 and 3 return
+    capture2 = np.array(capture)
+    d = offsets[1] + 500
+    capture2[d:d + 16] = -capture2[d:d + 16]
+    got2 = search.scan_and_decode(capture2, mesh=stream_mesh(8))
+    assert len(got2) == 2
+    np.testing.assert_array_equal(
+        got2[0][1], np.asarray(bytes_to_bits(psdus[0])))
+    np.testing.assert_array_equal(
+        got2[1][1], np.asarray(bytes_to_bits(psdus[2])))

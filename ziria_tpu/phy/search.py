@@ -89,3 +89,72 @@ def find_packets(samples, threshold: float = 0.75, window: int = 48,
             starts.append(a)
             last_end = b
     return np.asarray(starts, np.int64)
+
+
+def _receiver():
+    """The hybridized in-language receiver, compiled once per process
+    (jit caches live on the comp's chunk machines — recompiling per
+    call would discard them all)."""
+    global _RECEIVER
+    if _RECEIVER is None:
+        import os
+
+        from ziria_tpu.backend import hybrid as H
+        from ziria_tpu.frontend import compile_file
+        src = os.path.join(os.path.dirname(__file__), "..", "..",
+                           "examples", "wifi_rx.zir")
+        if not os.path.exists(src):
+            raise FileNotFoundError(
+                f"scan_and_decode needs the in-language receiver at "
+                f"{src} (pass comp= when running from an installed "
+                f"package without the examples tree)")
+        _RECEIVER = H.hybridize(compile_file(src).comp)
+    return _RECEIVER
+
+
+_RECEIVER = None
+
+
+def scan_and_decode(samples, mesh=None, axis: str = "sp",
+                    threshold: float = 0.75,
+                    max_frame_samples: int = 1 << 17,
+                    comp=None):
+    """Find every packet in a long capture and decode them ALL as one
+    frame batch — the composition of the framework's two new axes:
+    the detection metric shards over an `sp` mesh (halo exchange),
+    and the per-packet decodes run the in-language receiver
+    (examples/wifi_rx.zir) with their chunk-machine device steps
+    batched across packets (backend/framebatch), so N packets cost
+    ~the device calls of one. Returns [(start_index, payload_bits)]
+    for packets whose in-language FCS validated; corrupted packets
+    are dropped by the receiver itself.
+
+    samples: (n, 2) int16 IQ pairs (the complex16 wire format).
+    `max_frame_samples` defaults past the longest legal 802.11a frame
+    (4095-byte PSDU at 6 Mbps ~ 110k samples): a window truncated by
+    this limit fails the FCS and would be silently indistinguishable
+    from a corrupted packet. `comp` overrides the receiver (any
+    hybridized complex16->bit stream computer).
+    """
+    from ziria_tpu.backend.framebatch import run_many
+
+    arr = np.asarray(samples)
+    starts = find_packets(arr, threshold=threshold, mesh=mesh,
+                          axis=axis)
+    if len(starts) == 0:
+        return []
+    hyb = comp if comp is not None else _receiver()
+
+    bounds = list(starts[1:]) + [len(arr)]
+    wins = []
+    for s, nxt in zip(starts, bounds):
+        lo = max(0, int(s) - 24)         # margin before the STS start
+        hi = min(int(nxt), int(s) + max_frame_samples, len(arr))
+        wins.append([p for p in arr[lo:hi]])
+
+    out = []
+    for s, r in zip(starts, run_many(hyb, wins)):
+        bits = np.asarray(r.out_array(), np.uint8)
+        if bits.size:
+            out.append((int(s), bits))
+    return out
