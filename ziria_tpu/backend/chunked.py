@@ -96,6 +96,20 @@ def _step_call(node: "_ChunkLoop", key, args):
     return out
 
 
+def step_meta(it_a, pos_a, out_n_a):
+    """(it, pos, out_n) as host ints in ONE device->host transfer.
+    Through a high-latency host link (the ~68 ms axon tunnel), three
+    separate int() reads are three blocking round trips; stacking on
+    device first makes them one. Values already on the host (batched
+    fire, interpreter fallback) pass straight through."""
+    if isinstance(it_a, (int, np.integer, np.ndarray)):
+        return int(it_a), int(pos_a), int(out_n_a)
+    import jax.numpy as jnp
+    m = np.asarray(jnp.stack([jnp.asarray(it_a), jnp.asarray(pos_a),
+                              jnp.asarray(out_n_a)]))
+    return int(m[0]), int(m[1]), int(m[2])
+
+
 class _Unboundable(_Unstageable):
     pass
 
@@ -869,14 +883,13 @@ class _ChunkLoop(ir.Comp):
                 write_back(final=True)
                 return (yield from fallback())
 
-            new_it = int(it_a)
-            consumed = int(pos_a)
+            new_it, consumed, out_k = step_meta(it_a, pos_a, out_n_a)
             for m, v in zip(names, rvals_a):
                 vals[name_idx[m]] = v
             write_back(final=False)
 
             if out_cap:
-                k = int(out_n_a)
+                k = out_k
                 if k:
                     flush = np.asarray(out_buf_a[:k])
                     for row in flush:

@@ -153,10 +153,28 @@ class StepBatcher:
                     stacked = jax.tree_util.tree_map(
                         lambda *xs: jnp.stack(xs),
                         *[r.args for r in padded])
-                    out = self._vfn(reqs[0].node, reqs[0].key)(*stacked)
+                    it_b, pos_b, out_n_b, out_buf_b, rvals_b = \
+                        self._vfn(reqs[0].node, reqs[0].key)(*stacked)
+                    # every lane's (it, pos, out_n) in ONE transfer,
+                    # and every lane's emitted prefix in one more: per
+                    # -lane scalar reads and per-lane buffer flushes
+                    # through a high-latency host link would cost a
+                    # round trip each and dwarf the batched call
+                    metas = np.asarray(jnp.stack(
+                        [it_b, pos_b, out_n_b], axis=1))
+                    bufs = None
+                    if getattr(out_buf_b, "ndim", 0) >= 2:
+                        max_k = int(metas[:lanes, 2].max())
+                        if max_k:
+                            bufs = np.asarray(
+                                out_buf_b[:lanes, :max_k])
                     for i, r in enumerate(reqs):
-                        r.result = jax.tree_util.tree_map(
-                            lambda x, i=i: x[i], out)
+                        ob = bufs[i] if bufs is not None \
+                            else out_buf_b[i]
+                        r.result = (metas[i, 0], metas[i, 1],
+                                    metas[i, 2], ob,
+                                    jax.tree_util.tree_map(
+                                        lambda x, i=i: x[i], rvals_b))
                 C.STATS["device_calls"] += 1
                 self.device_calls += 1
                 self.group_sizes.append(len(reqs))
