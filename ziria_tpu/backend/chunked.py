@@ -595,13 +595,10 @@ def _bucket(n: int) -> int:
     return b
 
 
-def _to_host_small(x):
-    """Write-back policy shared with _JitDo: small leaves become numpy
-    (the interpreter's per-item fast path), big buffers stay device-
-    resident for the next jit block."""
-    if hasattr(x, "size") and getattr(x, "size", 0) > 4096:
-        return x
-    return np.asarray(x)
+# write-back policy (shared with _JitDo): small leaves become numpy —
+# the interpreter's per-item fast path — while buffers over this many
+# elements stay device-resident for the next jit block
+HOST_SMALL_MAX = 4096
 
 
 class _ChunkLoop(ir.Comp):
@@ -823,7 +820,16 @@ class _ChunkLoop(ir.Comp):
         def write_back(final: bool) -> None:
             wvals = [vals[name_idx[m]] for m in names]
             if final:
-                wvals = [_to_host_small(v) for v in wvals]
+                # ALL small leaves come back in one device_get instead
+                # of a blocking read per leaf (each a host-link round
+                # trip); big buffers stay device-resident
+                import jax
+                small = [i for i, v in enumerate(wvals)
+                         if getattr(v, "size", 0) <= HOST_SMALL_MAX]
+                if small:
+                    got = jax.device_get([wvals[i] for i in small])
+                    for i, g in zip(small, got):
+                        wvals[i] = np.asarray(g)
             for m, v in zip(names, wvals):
                 env.set(m, v)
 
