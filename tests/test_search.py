@@ -101,3 +101,50 @@ def test_scan_and_decode_batch():
         got2[0][1], np.asarray(bytes_to_bits(psdus[0])))
     np.testing.assert_array_equal(
         got2[1][1], np.asarray(bytes_to_bits(psdus[2])))
+
+
+def test_cli_scan(tmp_path):
+    """--scan end-to-end: capture file in, concatenated validated
+    payloads out; --sp shards the metric."""
+    from ziria_tpu.phy import channel
+    from ziria_tpu.runtime.buffers import (StreamSpec, read_stream,
+                                           write_stream)
+    from ziria_tpu.runtime.cli import main as cli_main
+    from ziria_tpu.utils.bits import bytes_to_bits
+
+    rng = np.random.default_rng(9)
+    psdus, parts = [], []
+    gap = lambda n: np.clip(np.round(rng.normal(
+        scale=20.0, size=(n, 2))), -32768, 32767).astype(np.int16)
+    parts.append(gap(800))
+    for k, (mbps, nb) in enumerate([(24, 50), (12, 40)]):
+        psdu, xi = channel.impaired_capture(
+            mbps, nb, seed=800 + k, cfo=0.001, pre=0, post=0,
+            noise=0.02, add_fcs=True)
+        psdus.append(psdu)
+        parts.append(np.asarray(xi))
+        parts.append(gap(800))
+    cap = np.concatenate(parts, axis=0)
+
+    inf = tmp_path / "cap.bin"
+    outf = tmp_path / "pay.bin"
+    write_stream(StreamSpec(ty="complex16", path=str(inf), mode="bin"),
+                 cap)
+    rc = cli_main([
+        "--scan", "--sp=8", "--input=file",
+        f"--input-file-name={inf}", "--input-file-mode=bin",
+        "--output=file", f"--output-file-name={outf}",
+        "--output-file-mode=bin"])
+    assert rc == 0
+    got = read_stream(StreamSpec(ty="bit", path=str(outf), mode="bin"))
+    want = np.concatenate([np.asarray(bytes_to_bits(p))
+                           for p in psdus])
+    np.testing.assert_array_equal(got[: want.shape[0]], want)
+
+
+def test_cli_scan_validation(tmp_path):
+    from ziria_tpu.runtime.cli import main as cli_main
+    with pytest.raises(SystemExit, match="in-language receiver"):
+        cli_main(["--scan", "--src=examples/scrambler.zir"])
+    with pytest.raises(SystemExit, match="needs --input=file"):
+        cli_main(["--scan", "--input=dummy"])

@@ -146,6 +146,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="item type (default: from the program's write[t], "
                         "else int32)")
 
+    p.add_argument("--scan", action="store_true",
+                   help="treat the input as one LONG capture: find "
+                        "every packet (sp-sharded STS metric when "
+                        "--sp=N is given) and decode them all as one "
+                        "frame batch through the in-language receiver "
+                        "(phy/search.scan_and_decode); the output "
+                        "stream is the concatenated validated "
+                        "payloads, packet starts print with --verbose")
     p.add_argument("--batch-input-files", metavar="F1,F2,...",
                    help="decode N independent input streams in ONE "
                         "process, batching the compiled program's "
@@ -297,6 +305,9 @@ def main(argv=None) -> int:
         for name in sorted(PROGS):
             print(name)
         return 0
+
+    if args.scan:
+        return _run_scan(args)
 
     comp, src_in_ty, src_out_ty = _resolve_prog(args)
     in_ty = args.input_type or src_in_ty or "int32"
@@ -454,6 +465,46 @@ def _run_auto_pp(comp, xs, args, t0):
     ys = (np.concatenate(outs, axis=0) if outs
           else np.empty((0,) + xs.shape[1:], xs.dtype))
     return ys, time.perf_counter() - t0
+
+
+def _run_scan(args) -> int:
+    """--scan: long-capture workflow — sp-shardable packet search +
+    frame-batched decode of every hit (phy/search.scan_and_decode).
+    The program is fixed (the in-language receiver); --src/--prog are
+    rejected so a mismatch cannot pass silently."""
+    if args.src or args.prog:
+        raise SystemExit("--scan uses the in-language receiver; drop "
+                         "--src/--prog")
+    if args.profile or args.pp is not None or args.state_in \
+            or args.state_out or args.batch_input_files:
+        raise SystemExit("--scan cannot combine with "
+                         "--pp/--profile/--state-*/--batch-*")
+    if args.input != "file" or not args.input_file_name:
+        raise SystemExit("--scan needs --input=file with "
+                         "--input-file-name (a complex16 capture)")
+    from ziria_tpu.phy.search import scan_and_decode
+
+    xs = read_stream(StreamSpec(kind="file", ty="complex16",
+                                path=args.input_file_name,
+                                mode=args.input_file_mode))
+    mesh = None
+    if args.sp is not None:
+        from ziria_tpu.parallel.streampar import stream_mesh
+        mesh = stream_mesh(args.sp)
+    t0 = time.perf_counter()
+    hits = scan_and_decode(xs, mesh=mesh)
+    dt = time.perf_counter() - t0
+    payload = (np.concatenate([b for _s, b in hits])
+               if hits else np.empty((0,), np.uint8))
+    write_stream(StreamSpec(kind=args.output, ty="bit",
+                            path=args.output_file_name,
+                            mode=args.output_file_mode), payload)
+    if args.verbose:
+        print(f"scan: {xs.shape[0]} samples, {len(hits)} packet(s) "
+              f"validated at {[s for s, _b in hits]}, "
+              f"{payload.shape[0]} payload bits, time: {dt:.3f}s",
+              file=sys.stderr)
+    return 0
 
 
 def _run_batch_files(comp, args, in_ty, out_ty) -> int:
