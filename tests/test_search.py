@@ -140,6 +140,9 @@ def test_cli_scan(tmp_path):
     want = np.concatenate([np.asarray(bytes_to_bits(p))
                            for p in psdus])
     np.testing.assert_array_equal(got[: want.shape[0]], want)
+    # nothing beyond the two payloads except bin-mode byte padding
+    assert got.shape[0] - want.shape[0] < 8
+    assert not np.any(got[want.shape[0]:])
 
 
 def test_cli_scan_validation(tmp_path):

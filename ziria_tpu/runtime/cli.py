@@ -475,30 +475,40 @@ def _run_scan(args) -> int:
     if args.src or args.prog:
         raise SystemExit("--scan uses the in-language receiver; drop "
                          "--src/--prog")
-    if args.profile or args.pp is not None or args.state_in \
-            or args.state_out or args.batch_input_files:
-        raise SystemExit("--scan cannot combine with "
-                         "--pp/--profile/--state-*/--batch-*")
+    if args.profile or args.profile_trace or args.stats \
+            or args.pp is not None or args.state_in \
+            or args.state_out or args.batch_input_files \
+            or args.batch_output_files:
+        raise SystemExit("--scan cannot combine with --pp/--profile/"
+                         "--profile-trace/--stats/--state-*/--batch-*")
     if args.input != "file" or not args.input_file_name:
         raise SystemExit("--scan needs --input=file with "
                          "--input-file-name (a complex16 capture)")
+    if args.sp is not None and args.sp < 1:
+        raise SystemExit(f"--sp={args.sp}: need at least 1 device")
+    # fail on a bad output spec BEFORE the scan spends minutes
+    out_spec = StreamSpec(kind=args.output, ty="bit",
+                          path=args.output_file_name,
+                          mode=args.output_file_mode)
+    from ziria_tpu.parallel.streampar import StreamParError
     from ziria_tpu.phy.search import scan_and_decode
 
     xs = read_stream(StreamSpec(kind="file", ty="complex16",
                                 path=args.input_file_name,
                                 mode=args.input_file_mode))
-    mesh = None
-    if args.sp is not None:
-        from ziria_tpu.parallel.streampar import stream_mesh
-        mesh = stream_mesh(args.sp)
-    t0 = time.perf_counter()
-    hits = scan_and_decode(xs, mesh=mesh)
+    try:
+        mesh = None
+        if args.sp is not None:
+            from ziria_tpu.parallel.streampar import stream_mesh
+            mesh = stream_mesh(args.sp)
+        t0 = time.perf_counter()
+        hits = scan_and_decode(xs, mesh=mesh)
+    except StreamParError as e:
+        raise SystemExit(f"--sp={args.sp}: {e}")
     dt = time.perf_counter() - t0
     payload = (np.concatenate([b for _s, b in hits])
                if hits else np.empty((0,), np.uint8))
-    write_stream(StreamSpec(kind=args.output, ty="bit",
-                            path=args.output_file_name,
-                            mode=args.output_file_mode), payload)
+    write_stream(out_spec, payload)
     if args.verbose:
         print(f"scan: {xs.shape[0]} samples, {len(hits)} packet(s) "
               f"validated at {[s for s, _b in hits]}, "
