@@ -256,3 +256,30 @@ def test_cli_batch_validation(tmp_path):
     with pytest.raises(SystemExit, match="cannot combine"):
         cli_main([f"--src={src}", "--batch-input-files=a",
                   "--batch-output-files=c", "--sp=4"])
+
+
+def test_cli_compile_cache(tmp_path):
+    """--compile-cache: the flag configures the persistent XLA cache
+    (in-process verification — this process's jit memo means tiny
+    graphs may not hit disk) and the run is output-identical."""
+    import jax
+
+    src = os.path.join(EXAMPLES, "fir.zir")
+    cache = tmp_path / "xla_cache"
+    xs = (100 * np.sin(np.arange(200) / 5)).astype(np.int32)
+    outs = []
+    for k in range(2):
+        inf = tmp_path / f"in{k}.dbg"
+        outf = tmp_path / f"out{k}.dbg"
+        write_stream(StreamSpec(ty="int32", path=str(inf), mode="dbg"),
+                     xs)
+        rc = cli_main([
+            f"--src={src}", "--input=file",
+            f"--input-file-name={inf}", "--input-file-mode=dbg",
+            "--output=file", f"--output-file-name={outf}",
+            "--output-file-mode=dbg", "--backend=jit",
+            f"--compile-cache={cache}"])
+        assert rc == 0
+        outs.append(outf.read_text())
+    assert outs[0] == outs[1]
+    assert jax.config.jax_compilation_cache_dir == str(cache)

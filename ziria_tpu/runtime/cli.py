@@ -214,6 +214,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--state-out",
                    help="write final stream state to this checkpoint")
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(or env ZIRIA_COMPILE_CACHE): repeat driver "
+                        "invocations of the same program skip "
+                        "first-compile costs")
     p.add_argument("--platform", default=None,
                    help="pin the JAX platform (e.g. cpu, tpu) before "
                         "backend init; also via ZIRIA_PLATFORM env var")
@@ -235,6 +240,26 @@ def _resolve_prog(args):
         raise SystemExit(
             f"unknown prog {args.prog!r}; known: {', '.join(sorted(PROGS))}")
     return PROGS[args.prog](), None, None
+
+
+def _apply_compile_cache(path: Optional[str]) -> None:
+    """Persistent XLA compilation cache for the driver: repeat CLI
+    invocations of the same program skip the first-compile cost
+    (20-40 s for the receiver's machines on a TPU, minutes on CPU).
+    Opt-in via --compile-cache=DIR or ZIRIA_COMPILE_CACHE; best-effort
+    — some PJRT plugins reject the config."""
+    path = path or os.environ.get("ZIRIA_COMPILE_CACHE")
+    if not path:
+        return
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception as e:
+        print(f"warning: compile cache unavailable: {e}",
+              file=sys.stderr)
 
 
 def _apply_platform(name: Optional[str]) -> None:
@@ -301,6 +326,7 @@ def _run_profiled(comp, xs, args):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _apply_platform(args.platform)
+    _apply_compile_cache(args.compile_cache)
     if args.list_progs:
         for name in sorted(PROGS):
             print(name)
