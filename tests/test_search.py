@@ -151,3 +151,25 @@ def test_cli_scan_validation(tmp_path):
         cli_main(["--scan", "--src=examples/scrambler.zir"])
     with pytest.raises(SystemExit, match="needs --input=file"):
         cli_main(["--scan", "--input=dummy"])
+
+
+def test_cli_scan_noise_only(tmp_path):
+    # a capture with no packets writes an EMPTY bit stream, exit 0
+    from ziria_tpu.runtime.buffers import (StreamSpec, read_stream,
+                                           write_stream)
+    from ziria_tpu.runtime.cli import main as cli_main
+
+    rng = np.random.default_rng(13)
+    cap = np.clip(np.round(rng.normal(scale=20.0, size=(4000, 2))),
+                  -32768, 32767).astype(np.int16)
+    inf = tmp_path / "noise.bin"
+    outf = tmp_path / "empty.bin"
+    write_stream(StreamSpec(ty="complex16", path=str(inf), mode="bin"),
+                 cap)
+    rc = cli_main(["--scan", "--input=file",
+                   f"--input-file-name={inf}", "--input-file-mode=bin",
+                   "--output=file", f"--output-file-name={outf}",
+                   "--output-file-mode=bin"])
+    assert rc == 0
+    got = read_stream(StreamSpec(ty="bit", path=str(outf), mode="bin"))
+    assert got.size == 0
