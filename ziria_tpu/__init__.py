@@ -12,7 +12,13 @@ backends:
   `jax.jit` step function (reshape/vmap/scan compositions), with chunk
   widths chosen by the vectorization planner becoming array axes, frames
   batched over a `jax.sharding.Mesh` data axis, and parallel-pipeline
-  stages sharded over chips.
+  stages sharded over chips;
+- a *hybrid* executor for dynamic-control programs — stream-control
+  loops compile into chunked masked `lax.while_loop` state machines
+  (backend/chunked.py), heavy do-blocks into cached jit fns, statement
+  loops lane-vectorize (including reductions, conditional inductions
+  and read-modify-write arrays), and N independent streams batch their
+  device steps into single vmapped calls (backend/framebatch.py).
 
 Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 
