@@ -82,7 +82,22 @@ CASES = [
     # (HYBRID_CASES) — detection, CFO, SIGNAL parse, rate dispatch and
     # decode all pinned by one file pair
     ("wifi_rx", "complex16", lambda: _rx_capture(24, 60, 119), "bin"),
+    # the multi-rate in-language TRANSMITTER: one 36 Mbps frame,
+    # in-band [rate, len, bits...] header (INTERP_CASES — runtime-
+    # parameterized whole-frame program)
+    ("wifi_tx_rates", "int32", lambda: _tx_rates_input(36, 54, 121),
+     "bin"),
 ]
+
+
+def _tx_rates_input(mbps, n_bytes, seed):
+    import numpy as np
+
+    from ziria_tpu.utils.bits import bytes_to_bits
+    rng = np.random.default_rng(seed)
+    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+    bits = np.asarray(bytes_to_bits(psdu)).astype(np.int32)
+    return np.concatenate([[mbps, n_bytes], bits]).astype(np.int32)
 
 
 def _iq_dc(n, seed):
@@ -107,7 +122,7 @@ FXP_CASES = {"tx_qpsk_fxp"}
 
 # cases replayed on the interpreter backend (whole-frame programs whose
 # fully-unrolled jit graphs take minutes of XLA compile on CPU)
-INTERP_CASES = {"wifi_tx_full"}
+INTERP_CASES = {"wifi_tx_full", "wifi_tx_rates"}
 
 # cases replayed with --autolut: the inferred-LUT rewrite must leave
 # the golden output untouched (flag invariance)

@@ -1,0 +1,117 @@
+"""The multi-rate transmitter as a program OF the framework
+(examples/wifi_tx_rates.zir): frames arrive in-band as
+[rate, len, bits...] on an int32 stream and leave as quantized IQ —
+ONE generic body covering all eight 802.11a rates with runtime
+parameters, the dual of wifi_rx.zir's decode_data (SURVEY.md §2.3,
+§3.5). Ground truth is the library transmitter bit-for-bit at
+quantization scale 512, and the flagship check closes the loop: the
+in-language TX drives the in-language RX at every modulation."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ziria_tpu.frontend import compile_file
+from ziria_tpu.interp.interp import run
+from ziria_tpu.ops.crc import append_crc32
+from ziria_tpu.phy.wifi import tx
+from ziria_tpu.utils.bits import bytes_to_bits
+
+SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
+                   "wifi_tx_rates.zir")
+RNG = np.random.default_rng(17)
+
+
+def _frame_input(mbps, psdu):
+    bits = np.asarray(bytes_to_bits(psdu)).astype(np.int32)
+    return np.concatenate([[mbps, len(psdu)], bits]).astype(np.int32)
+
+
+@pytest.mark.parametrize("mbps,n_bytes", [(6, 40), (9, 33), (12, 36),
+                                          (18, 45), (24, 50), (36, 54),
+                                          (48, 60), (54, 63)])
+def test_tx_rates_matches_library(mbps, n_bytes):
+    prog = compile_file(SRC)
+    psdu = RNG.integers(0, 256, n_bytes).astype(np.uint8)
+    out = np.asarray(run(prog.comp,
+                         list(_frame_input(mbps, psdu))).out_array())
+    want = np.round(np.asarray(tx.encode_frame(psdu, mbps)) * 512.0)
+    assert out.shape == want.shape
+    assert np.abs(out - want).max() <= 1.0
+
+
+@pytest.mark.parametrize("mbps,n_bytes", [(6, 30), (18, 45), (36, 52),
+                                          (54, 60)])
+def test_in_language_tx_rx_loop(mbps, n_bytes):
+    """The whole PHY as programs of the framework: multi-rate TX ->
+    quantized wire -> receiver (which validates and strips the FCS) —
+    payload bits round-trip exactly."""
+    from ziria_tpu.backend import hybrid as H
+
+    rng = np.random.default_rng(100 + mbps)
+    txp = compile_file(SRC)
+    rxp = H.hybridize(compile_file(os.path.join(
+        os.path.dirname(SRC), "wifi_rx.zir")).comp)
+
+    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+    bits = np.asarray(append_crc32(bytes_to_bits(psdu))).astype(np.int32)
+    xs = np.concatenate([[mbps, n_bytes + 4], bits]).astype(np.int32)
+    iq = np.asarray(run(txp.comp, list(xs)).out_array())
+    cap = np.clip(np.round(np.concatenate([
+        rng.normal(scale=8.0, size=(60, 2)), iq,
+        rng.normal(scale=8.0, size=(40, 2))])),
+        -32768, 32767).astype(np.int16)
+    out = np.asarray(run(rxp, [p for p in cap]).out_array(), np.uint8)
+    np.testing.assert_array_equal(out, np.asarray(bytes_to_bits(psdu)))
+
+
+def test_bad_header_consumed_stream_stays_synced():
+    # an unknown rate (or oversize len) eats its frame and emits
+    # nothing; the NEXT frame on the stream still transmits
+    prog = compile_file(SRC)
+    psdu = RNG.integers(0, 256, 36).astype(np.uint8)
+    bad = _frame_input(11, RNG.integers(0, 256, 20).astype(np.uint8))
+    good = _frame_input(12, psdu)
+    out = np.asarray(run(prog.comp,
+                         list(np.concatenate([bad, good]))).out_array())
+    want = np.round(np.asarray(tx.encode_frame(psdu, 12)) * 512.0)
+    assert out.shape == want.shape
+    assert np.abs(out - want).max() <= 1.0
+
+
+def test_hybrid_matches_interp():
+    from ziria_tpu.backend import hybrid as H
+    prog = compile_file(SRC)
+    psdu = RNG.integers(0, 256, 48).astype(np.uint8)
+    xs = list(_frame_input(24, psdu))
+    want = run(prog.comp, xs).out_array()
+    got = run(H.hybridize(prog.comp), xs).out_array()
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_max_size_frame_at_high_rates():
+    # code review r4: nbits rounds UP to a whole symbol, peaking at
+    # 2160 for ndbps 216/144 — the buffer sizing must cover it
+    prog = compile_file(SRC)
+    for mbps in (36, 54):
+        psdu = RNG.integers(0, 256, 256).astype(np.uint8)
+        out = np.asarray(run(prog.comp,
+                             list(_frame_input(mbps, psdu))).out_array())
+        want = np.round(np.asarray(tx.encode_frame(psdu, mbps)) * 512.0)
+        assert out.shape == want.shape
+        assert np.abs(out - want).max() <= 1.0
+
+
+def test_oversize_len_drains_and_stays_synced():
+    # code review r4: an oversize len must still drain its payload so
+    # the NEXT frame parses — no emission for the bad one
+    prog = compile_file(SRC)
+    psdu = RNG.integers(0, 256, 36).astype(np.uint8)
+    bad = _frame_input(6, RNG.integers(0, 256, 300).astype(np.uint8))
+    good = _frame_input(12, psdu)
+    out = np.asarray(run(prog.comp,
+                         list(np.concatenate([bad, good]))).out_array())
+    want = np.round(np.asarray(tx.encode_frame(psdu, 12)) * 512.0)
+    assert out.shape == want.shape
+    assert np.abs(out - want).max() <= 1.0
