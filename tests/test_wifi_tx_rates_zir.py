@@ -115,3 +115,37 @@ def test_oversize_len_drains_and_stays_synced():
     want = np.round(np.asarray(tx.encode_frame(psdu, 12)) * 512.0)
     assert out.shape == want.shape
     assert np.abs(out - want).max() <= 1.0
+
+
+def test_tx_rates_under_framebatch():
+    # N transmit frames batched: the TX's take/emit machines ride
+    # shared vmapped steps; every stream bit-identical to its solo run
+    from ziria_tpu.backend import hybrid as H
+    from ziria_tpu.backend.framebatch import StepBatcher, run_many
+
+    hyb = H.hybridize(compile_file(SRC).comp)
+    rng = np.random.default_rng(7)
+    frames = []
+    for mbps in (6, 12, 24, 54, 24, 24):
+        psdu = rng.integers(0, 256, int(rng.integers(20, 80))
+                            ).astype(np.uint8)
+        frames.append(_frame_input(mbps, psdu))
+    want = [run(hyb, list(f)) for f in frames]
+    got = run_many(hyb, frames, batcher=StepBatcher(len(frames)))
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w.out_array()),
+                                      np.asarray(g.out_array()))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_tx_rates_fuzz_vs_library(seed):
+    rng = np.random.default_rng(400 + seed)
+    prog = compile_file(SRC)
+    mbps = int(rng.choice([6, 9, 12, 18, 24, 36, 48, 54]))
+    nb = int(rng.integers(1, 257))
+    psdu = rng.integers(0, 256, nb).astype(np.uint8)
+    out = np.asarray(run(prog.comp,
+                         list(_frame_input(mbps, psdu))).out_array())
+    want = np.round(np.asarray(tx.encode_frame(psdu, mbps)) * 512.0)
+    assert out.shape == want.shape, (mbps, nb)
+    assert np.abs(out - want).max() <= 1.0, (mbps, nb)
