@@ -1,0 +1,230 @@
+"""GF(2) affine loop compression (frontend/gf2.py, "autolin").
+
+LFSR-family loops — CRC registers, scramblers/descramblers — are true
+bit recurrences the lane vectorizer rightly refuses, but they are
+affine over GF(2), so K iterations collapse into one bit-matrix block
+step. The contract is BIT-exactness with the interpreter oracle and
+with the uncompressed staging (ZIRIA_NO_GF2_LOOPS=1), for static AND
+traced trip counts, including remainder tails and range splits at
+loop-variable comparisons. The reference kept these loops fast as C
+scalar code (SURVEY.md §2.3 scramble/crc blocks); the TPU-idiomatic
+equivalent is linear algebra, not a faster scalar loop.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ziria_tpu.frontend.gf2 as G
+from ziria_tpu.backend.execute import run_jit
+from ziria_tpu.frontend import compile_source
+from ziria_tpu.interp.interp import run
+
+# LSB-first CRC-32 polynomial bits (0xEDB88320), as in examples
+_POLY = ("{'0, '0, '0, '0, '0, '1, '0, '0, '1, '1, '0, '0, '0, '0, "
+         "'0, '1, '0, '0, '0, '1, '1, '1, '0, '1, '1, '0, '1, '1, "
+         "'0, '1, '1, '1}")
+
+
+def _crc_src(n: int) -> str:
+    return f"""
+    let comp main = read[bit] >>> repeat {{
+      (v : arr[{n}] bit) <- takes {n};
+      var reg : arr[32] bit;
+      do {{
+        var poly : arr[32] bit := {_POLY};
+        for t in [0, 32] {{ reg[t] := '1 }};
+        for p in [0, {n}] {{
+          let fb = reg[0] ^ v[p];
+          reg[0, 31] := reg[1, 31];
+          reg[31] := '0;
+          if (fb == '1) then {{
+            for t in [0, 32] {{ reg[t] := reg[t] ^ poly[t] }}
+          }}
+        }}
+      }};
+      emits reg
+    }} >>> write[bit]
+    """
+
+
+def _both(src, xs):
+    prog = compile_source(src)
+    want = run(prog.comp, list(xs)).out_array()
+    got = np.asarray(run_jit(prog.comp, xs))
+    np.testing.assert_array_equal(np.asarray(want, np.uint8), got)
+    return got
+
+
+def _engaged(src, xs, expect: bool):
+    hits = []
+    orig = G.gf2_for
+
+    def spy(*a):
+        r = orig(*a)
+        hits.append(r)
+        return r
+
+    G.gf2_for = spy
+    try:
+        _both(src, xs)
+    finally:
+        G.gf2_for = orig
+    assert any(hits) == expect, hits
+
+
+def _bits(n, seed=0):
+    return np.random.RandomState(seed).randint(0, 2, n).astype(np.uint8)
+
+
+def test_crc_register_compresses_exact():
+    _engaged(_crc_src(4096), _bits(4096), True)
+
+
+@pytest.mark.parametrize("n", [160, 257, 500, 4096 + 37])
+def test_tail_remainders_exact(n):
+    # lengths off the K=64 block grid exercise the staged tail
+    _engaged(_crc_src(n), _bits(n, seed=n), True)
+
+
+@pytest.mark.parametrize("n", [96, 127])
+def test_short_loops_fall_back_exact(n):
+    # below the 2K engagement floor: ordinary staging, still exact
+    _engaged(_crc_src(n), _bits(n, seed=n), False)
+
+
+def test_traced_count_with_range_split():
+    # descrambler shape: data-dependent trip count (traced), a
+    # loop-var comparison splitting the domain at p=16, and a stream
+    # output written at stride 1 — the wifi_rx.zir descramble pattern
+    src = """
+    let comp main = read[bit] >>> repeat {
+      (v : arr[2048] bit) <- takes 2048;
+      var st : arr[7] bit;
+      var fb : bit := '0;
+      var clear : arr[2048] bit;
+      var n : int32 := 1500;
+      do {
+        if (v[0] == '1) then { n := 1800 };
+        for k in [0, 7] { st[k] := v[6 - k] };
+        for p in [7, n] {
+          fb := st[6] ^ st[3];
+          st[1, 6] := st[0, 6];
+          st[0] := fb;
+          if (p >= 16) then { clear[p - 16] := v[p] ^ fb }
+        }
+      };
+      emits clear[0, 1400]
+    } >>> write[bit]
+    """
+    for seed in (0, 1):
+        xs = _bits(2048, seed=seed)
+        xs[0] = seed              # exercise both traced trip counts
+        _engaged(src, xs, True)
+
+
+def test_nonlinear_body_bails_exact():
+    # AND of two state bits is quadratic over GF(2): must refuse and
+    # fall back to ordinary staging, bit-exactly
+    src = """
+    let comp main = read[bit] >>> repeat {
+      (v : arr[512] bit) <- takes 512;
+      var reg : arr[8] bit;
+      do {
+        for p in [0, 512] {
+          let fb = (reg[0] & reg[3]) ^ v[p];
+          reg[0, 7] := reg[1, 7];
+          reg[7] := fb
+        }
+      };
+      emits reg
+    } >>> write[bit]
+    """
+    _engaged(src, _bits(512, seed=3), False)
+
+
+def test_non_bit_output_array_bails_exact():
+    # code review r4: an int32 output stream has no GF(2) form — the
+    # pass must refuse, not truncate values mod 2. Traced trip count
+    # so there is no 2K engagement floor masking the hole.
+    src = """
+    let comp main = read[bit] >>> repeat {
+      (v : arr[512] bit) <- takes 512;
+      var out : arr[512] int32;
+      var n : int32 := 400;
+      do {
+        if (v[0] == '1) then { n := 500 };
+        for p in [0, n] { out[p] := 5 }
+      };
+      emits out[0, 400]
+    } >>> write[int32]
+    """
+    _engaged(src, _bits(512, seed=11), False)
+
+
+def test_non_bit_scalar_state_bails_exact():
+    # code review r4: an int32 scalar written inside an LFSR loop is
+    # not 1-bit state; trip count a multiple of K so no remainder tail
+    # re-executes (and masks) the bad write-back
+    src = """
+    let comp main = read[bit] >>> repeat {
+      (v : arr[512] bit) <- takes 512;
+      var reg : arr[8] bit;
+      var last : int32 := 0;
+      do {
+        for p in [0, 512] {
+          let fb = reg[0] ^ v[p];
+          reg[0, 7] := reg[1, 7];
+          reg[7] := fb;
+          last := 3
+        }
+      };
+      emit last;
+      emit last
+    } >>> write[int32]
+    """
+    _engaged(src, _bits(512, seed=12), False)
+
+
+def test_killswitch_ab_exact():
+    src = _crc_src(1024)
+    xs = _bits(1024, seed=9)
+    prog = compile_source(src)
+    want = np.asarray(run_jit(prog.comp, xs))
+    os.environ["ZIRIA_NO_GF2_LOOPS"] = "1"
+    try:
+        got = np.asarray(run_jit(compile_source(src).comp, xs))
+    finally:
+        del os.environ["ZIRIA_NO_GF2_LOOPS"]
+    np.testing.assert_array_equal(want, got)
+
+
+def test_wifi_rx_zir_lfsr_loops_engage():
+    # the flagship program's descramble AND FCS loops both compress
+    # under the hybrid executor, and the decode stays bit-exact
+    from ziria_tpu.backend import hybrid as HY
+    from ziria_tpu.frontend import compile_file
+    from ziria_tpu.phy import channel
+    from ziria_tpu.utils.bits import bytes_to_bits
+
+    srcf = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "wifi_rx.zir")
+    psdu, xi = channel.impaired_capture(24, 60, seed=5, add_fcs=True)
+    hits = []
+    orig = G.gf2_for
+
+    def spy(*a):
+        r = orig(*a)
+        hits.append(r)
+        return r
+
+    G.gf2_for = spy
+    try:
+        hyb = HY.hybridize(compile_file(srcf).comp)
+        out = run(hyb, [p for p in xi]).out_array()
+    finally:
+        G.gf2_for = orig
+    assert sum(hits) >= 2, hits   # descramble + FCS register
+    want = np.asarray(bytes_to_bits(psdu))
+    np.testing.assert_array_equal(np.asarray(out, np.uint8), want)

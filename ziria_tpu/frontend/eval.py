@@ -1597,7 +1597,7 @@ def _vectorized_for(start: int, count: int, st: A.SFor, scope: Scope,
 
 
 def _staged_for(start, count, st: A.SFor, scope: Scope,
-                ctx: Ctx):
+                ctx: Ctx, try_gf2: bool = True):
     """Stage one statement for-loop as `lax.fori_loop` carrying the
     cells the body writes (same discipline as _staged_while: stable
     tree structure, entry-pinned leaf dtypes). The loop variable is the
@@ -1614,6 +1614,15 @@ def _staged_for(start, count, st: A.SFor, scope: Scope,
     if isinstance(start, int) and isinstance(count, int) \
             and _vectorized_for(start, count, st, scope, ctx):
         return None
+
+    # then GF(2) affine-recurrence compression (frontend/gf2.py): LFSR
+    # family loops (scramble/descramble/CRC) collapse to K-iteration
+    # bit-matrix blocks; `try_gf2=False` marks its own remainder-tail
+    # re-entry
+    if try_gf2:
+        from .gf2 import gf2_for
+        if gf2_for(start, count, st, scope, ctx):
+            return None
 
     cells = _written_cells(st.body, scope)
 

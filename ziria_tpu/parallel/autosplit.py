@@ -117,10 +117,13 @@ def stage_runner(st: ir.Comp, cur, width: Optional[int] = None):
 
 
 def measured_stage_costs(flat: Sequence[ir.Comp], sample,
-                         width: Optional[int] = None) -> List[float]:
+                         width: Optional[int] = None,
+                         reps: int = 3) -> List[float]:
     """Wall-time each leaf stage on a sample of the REAL input (one
-    warm pass to absorb compilation, one timed), cascading each
-    stage's output into the next — the measured replacement for the
+    warm pass to absorb compilation, then min-of-`reps` timed passes —
+    the min discards scheduler preemption spikes, which on a loaded
+    host otherwise misrank same-rate stages), cascading each stage's
+    output into the next — the measured replacement for the
     items-moved proxy (`--pp-costs=measured`; ROADMAP r4 §4)."""
     import time as _time
 
@@ -137,10 +140,13 @@ def measured_stage_costs(flat: Sequence[ir.Comp], sample,
                 f"stage; stage {st.label()} received 0 items (sample "
                 f"too short for the upstream take rates?)")
         go = stage_runner(st, cur, width=width)
-        go()                                  # warm-up / compile
-        t0 = _time.perf_counter()
-        out = go()
-        costs.append(max(_time.perf_counter() - t0, 1e-9))
+        out = go()                            # warm-up / compile
+        best = float("inf")
+        for _ in range(max(1, reps)):
+            t0 = _time.perf_counter()
+            out = go()
+            best = min(best, _time.perf_counter() - t0)
+        costs.append(max(best, 1e-9))
         cur = out
     return costs
 
