@@ -25,10 +25,17 @@ alone (pre-transposed inputs), per frame, so the regression's locus
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# run as a script from tools/: only tools/ lands on sys.path, the repo
+# root is not — same bootstrap as hybrid_tpu_check.py (this exact miss
+# cost the first successful TPU window its sweep artifact, r4)
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
 
 
 def main():
