@@ -87,7 +87,21 @@ CASES = [
     # parameterized whole-frame program)
     ("wifi_tx_rates", "int32", lambda: _tx_rates_input(36, 54, 121),
      "bin"),
+    # in-language LOOPBACK: MAC frames -> fcs_add >>> tx_frame >>> rx
+    # across two rates in one stream; output must equal the payload
+    # bits exactly (FCS generated TX-side, validated+stripped RX-side)
+    ("wifi_loopback", "int32", lambda: _loopback_input(122), "bin"),
 ]
+
+
+def _loopback_input(seed):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    stream = []
+    for rate, n_bytes in ((6, 20), (24, 30)):
+        bits = rng.integers(0, 2, 8 * n_bytes).astype(np.int32)
+        stream += [rate, n_bytes] + bits.tolist()
+    return np.asarray(stream, np.int32)
 
 
 def _tx_rates_input(mbps, n_bytes, seed):
@@ -122,7 +136,7 @@ FXP_CASES = {"tx_qpsk_fxp"}
 
 # cases replayed on the interpreter backend (whole-frame programs whose
 # fully-unrolled jit graphs take minutes of XLA compile on CPU)
-INTERP_CASES = {"wifi_tx_full", "wifi_tx_rates"}
+INTERP_CASES = {"wifi_tx_full", "wifi_tx_rates", "wifi_loopback"}
 
 # cases replayed with --autolut: the inferred-LUT rewrite must leave
 # the golden output untouched (flag invariance)
@@ -143,7 +157,10 @@ def main() -> None:
     from ziria_tpu.runtime.buffers import StreamSpec, write_stream
 
     os.makedirs(GOLD, exist_ok=True)
+    only = set(sys.argv[1:])          # regenerate a subset by name
     for name, in_ty, make, mode in CASES:
+        if only and name not in only:
+            continue
         src = os.path.join(HERE, f"{name}.zir")
         prog = compile_file(src, fxp_complex16=name in FXP_CASES)
         xs = make()

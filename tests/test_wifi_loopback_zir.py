@@ -1,0 +1,109 @@
+"""The in-language loopback program: examples/wifi_loopback.zir.
+
+MAC-shaped frames ([rate, len, payload bits] in-band on an int32
+stream) travel the COMPLETE PHY both directions inside one program:
+fcs_add (the reference TX chain's leading crc block, SURVEY.md §3.5)
+>>> tx_frame (lib/wifi_tx_lib.zir) >>> rx (lib/wifi_rx_lib.zir). The
+assertion is identity: the emitted bits equal the payload bits, FCS
+generated TX-side and validated+stripped RX-side. Also pins the
+#include machinery the program is built on (SURVEY.md §2.3 — the
+reference composes programs from block files via the preprocessor).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ziria_tpu.frontend import ElabError, compile_file, compile_source
+from ziria_tpu.interp.interp import run
+
+SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
+                   "wifi_loopback.zir")
+
+
+def _stream(frames):
+    out = []
+    for rate, bits in frames:
+        out += [rate, len(bits) // 8] + list(bits)
+    return [np.int32(x) for x in out]
+
+
+def _payload(frames):
+    return np.concatenate([np.asarray(b) for _r, b in frames])
+
+
+def test_loopback_identity_two_rates():
+    rs = np.random.RandomState(0)
+    frames = [(6, rs.randint(0, 2, 8 * 20).tolist()),
+              (24, rs.randint(0, 2, 8 * 30).tolist())]
+    prog = compile_file(SRC)
+    out = run(prog.comp, _stream(frames)).out_array()
+    np.testing.assert_array_equal(np.asarray(out, np.uint8),
+                                  _payload(frames))
+
+
+@pytest.mark.parametrize("rate", [9, 12, 18, 36, 48, 54])
+def test_loopback_identity_each_rate(rate):
+    rs = np.random.RandomState(rate)
+    n_bytes = int(rs.randint(8, 40))
+    frames = [(rate, rs.randint(0, 2, 8 * n_bytes).tolist())]
+    prog = compile_file(SRC)
+    out = run(prog.comp, _stream(frames)).out_array()
+    np.testing.assert_array_equal(np.asarray(out, np.uint8),
+                                  _payload(frames))
+
+
+def test_loopback_hybrid_matches():
+    rs = np.random.RandomState(7)
+    frames = [(12, rs.randint(0, 2, 8 * 24).tolist())]
+    prog = compile_file(SRC)
+    from ziria_tpu.backend import hybrid as HY
+    out = run(HY.hybridize(prog.comp), _stream(frames)).out_array()
+    np.testing.assert_array_equal(np.asarray(out, np.uint8),
+                                  _payload(frames))
+
+
+def test_loopback_bad_length_dropped_neighbors_survive():
+    # an over-length frame is consumed whole by fcs_add, which forwards
+    # length 0 so tx_frame rejects it deterministically TX-side (code
+    # review r4: lengths in (LENMAX-4, LENMAX] previously reached the
+    # air without an FCS); the next frame decodes intact
+    rs = np.random.RandomState(9)
+    bad = (24, rs.randint(0, 2, 8 * 253).tolist())   # > LENMAX - 4
+    good = (6, rs.randint(0, 2, 8 * 16).tolist())
+    prog = compile_file(SRC)
+    out = run(prog.comp, _stream([bad, good])).out_array()
+    np.testing.assert_array_equal(np.asarray(out, np.uint8),
+                                  _payload([good]))
+
+
+# ---- #include machinery -------------------------------------------------
+
+
+def test_include_missing_file_is_located_error():
+    with pytest.raises(ElabError, match=r"cannot include"):
+        compile_source('#include "no_such_lib.zir"\n'
+                       'let comp main = read[bit] >>> write[bit]',
+                       base_dir=os.path.dirname(SRC))
+
+
+def test_include_requires_file_compile():
+    with pytest.raises(ElabError, match=r"file-based"):
+        compile_source('#include "lib/wifi_tx_lib.zir"\n'
+                       'let comp main = read[bit] >>> write[bit]')
+
+
+def test_host_main_overrides_included(tmp_path):
+    lib = tmp_path / "l.zir"
+    lib.write_text("fun f(x: int32): int32 { return x + 1 }\n"
+                   "let comp main = read[int32] >>> map f "
+                   ">>> write[int32]\n")
+    host = tmp_path / "m.zir"
+    host.write_text('#include "l.zir"\n'
+                    "fun g(x: int32): int32 { return f(x) * 10 }\n"
+                    "let comp main = read[int32] >>> map g "
+                    ">>> write[int32]\n")
+    prog = compile_file(str(host))
+    out = run(prog.comp, [np.int32(1), np.int32(2)]).out_array()
+    np.testing.assert_array_equal(np.asarray(out), [20, 30])

@@ -17,6 +17,8 @@ reach the fused jit path.
 
 from __future__ import annotations
 
+import os
+import re
 from dataclasses import dataclass, field as dfield
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
 
@@ -914,11 +916,65 @@ def _file_ty(ty: A.Ty, src: str) -> str:
 # --------------------------------------------------------------------------
 
 
+_INCLUDE_RE = re.compile(
+    r'^\s*#\s*include\s+"([^"]+)"\s*(--.*)?$')
+
+
+def _load_program(src: str, src_name: str, base_dir: Optional[str],
+                  seen: set) -> A.Program:
+    """Parse `src` after resolving top-level `#include "path"` lines.
+
+    The reference's programs compose via the C preprocessor — tx.blk
+    pulls in the per-block files and lib/ ext declarations (SURVEY.md
+    §2.3). Here includes resolve at the DECLARATION level: each
+    include line is blanked in place (host line numbers stay exact),
+    the included file is parsed with its OWN src_name (parse errors
+    are file-accurate; type/elab/runtime diagnostics cite the host
+    program's name with the included file's line numbers — Loc is
+    (line, col) program-wide), and its declarations are prepended in
+    include order, so a host declaration of the same name (e.g.
+    `main`) overrides a library's. Paths are relative to the
+    including file; each resolved path is included once per program
+    (pragma-once semantics — mutual includes terminate)."""
+    lines = src.split("\n")
+    pre: List[A.Decl] = []
+    for i, ln in enumerate(lines):
+        m = _INCLUDE_RE.match(ln)
+        if m is None:
+            continue
+        if base_dir is None:
+            raise ElabError(
+                f"{src_name}:{i + 1}:1: #include requires a file-based "
+                f"compile (compile_file) so relative paths resolve")
+        inc = os.path.normpath(os.path.join(base_dir, m.group(1)))
+        lines[i] = ""
+        if inc in seen:
+            continue
+        seen.add(inc)
+        try:
+            with open(inc, "r") as fh:
+                inc_src = fh.read()
+        except OSError as e:
+            raise ElabError(
+                f"{src_name}:{i + 1}:1: cannot include "
+                f"{m.group(1)!r}: {e}") from None
+        pre.extend(_load_program(inc_src, inc,
+                                 os.path.dirname(inc), seen).decls)
+    prog = parse_program("\n".join(lines), src_name)
+    return A.Program(tuple(pre) + tuple(prog.decls))
+
+
 def compile_source(src: str, src_name: str = "<input>",
                    entry: str = "main", typecheck: bool = True,
                    fxp_complex16: bool = False,
-                   autolut: bool = False) -> CompiledProgram:
-    prog = parse_program(src, src_name)
+                   autolut: bool = False,
+                   base_dir: Optional[str] = None) -> CompiledProgram:
+    # seed `seen` with the root file itself so an include cycle back
+    # to the host cannot re-parse it and duplicate its declarations
+    seen = set()
+    if base_dir is not None:
+        seen.add(os.path.normpath(os.path.abspath(src_name)))
+    prog = _load_program(src, src_name, base_dir, seen)
     return Elaborator(prog, src_name, fxp_complex16=fxp_complex16,
                       autolut=autolut) \
         .build(entry, typecheck=typecheck)
@@ -931,4 +987,6 @@ def compile_file(path: str, entry: str = "main", typecheck: bool = True,
         return compile_source(fh.read(), path, entry,
                               typecheck=typecheck,
                               fxp_complex16=fxp_complex16,
-                              autolut=autolut)
+                              autolut=autolut,
+                              base_dir=os.path.dirname(
+                                  os.path.abspath(path)))
