@@ -137,7 +137,8 @@ def test_wifi_rx_zir_continuous_two_frames():
         r"let comp main = read\[complex16\] >>> rx\(\) >>> write\[bit\]",
         "let comp main = read[complex16] >>> repeat { rx() } "
         ">>> write[bit]", src_txt)
-    prog = compile_source(src_txt)
+    prog = compile_source(src_txt, src_name=SRC,
+                          base_dir=os.path.dirname(SRC))
 
     psdu1, x1 = _impaired_capture(24, 60, seed=31)
     psdu2, x2 = _impaired_capture(54, 90, seed=32)
@@ -192,7 +193,8 @@ def test_wifi_rx_zir_continuous_drops_bad_frame():
         r"let comp main = read\[complex16\] >>> rx\(\) >>> write\[bit\]",
         "let comp main = read[complex16] >>> repeat { rx() } "
         ">>> write[bit]", src_txt)
-    prog = compile_source(src_txt)
+    prog = compile_source(src_txt, src_name=SRC,
+                          base_dir=os.path.dirname(SRC))
 
     psdu1, x1 = _impaired_capture(24, 60, seed=41)
     psdu2, x2 = _impaired_capture(36, 70, seed=42)
