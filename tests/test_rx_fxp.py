@@ -1,0 +1,194 @@
+"""Fixed-point RX interior (ops/fxp + phy/wifi/rx_fxp).
+
+What the fixed-point path is FOR is reproducibility: every op is exact
+int32 arithmetic, so outputs must be bit-identical across eager/jit and
+across vmap widths — a stronger contract than the float path's
+tolerance-bounded flag-independence (SURVEY.md §4's key invariant,
+taken to equality). Plus numeric accuracy bounds for the primitives
+and end-to-end agreement with the float receiver.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ziria_tpu.ops import fxp
+from ziria_tpu.phy import channel
+from ziria_tpu.phy.wifi import rx, rx_fxp, tx
+from ziria_tpu.phy.wifi.params import RATES, n_symbols
+from ziria_tpu.utils.bits import bytes_to_bits
+
+
+# ------------------------------------------------------------ primitives
+
+def test_isqrt_exact():
+    rng = np.random.default_rng(0)
+    x = np.concatenate([
+        rng.integers(0, 2 ** 31 - 1, 3000),
+        np.array([0, 1, 2, 3, 4, 2 ** 31 - 1, 2 ** 30, 65535, 65536])])
+    got = np.asarray(fxp.isqrt_u32(jnp.asarray(x, jnp.int32)))
+    want = np.floor(np.sqrt(x.astype(np.float64))).astype(np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cordic_atan2_accuracy():
+    rng = np.random.default_rng(1)
+    pts = (rng.normal(size=(4000, 2)) * 1e5).astype(np.int32)
+    ang, mag = fxp.cordic_atan2(jnp.asarray(pts[:, 1]),
+                                jnp.asarray(pts[:, 0]))
+    ref = np.arctan2(pts[:, 1], pts[:, 0]) * (32768 / np.pi)
+    d = (np.asarray(ang) - ref + 32768) % 65536 - 32768
+    assert np.abs(d).max() <= 24          # ~0.13 degree
+    mref = np.hypot(pts[:, 0], pts[:, 1]) * 1.646760258121
+    ok = np.abs(np.asarray(mag) - mref) <= np.maximum(8, 2e-3 * mref)
+    assert ok.all()
+
+
+def test_cordic_atan2_axes_and_zero():
+    y = jnp.asarray(np.array([0, 0, 5000, -5000, 0], np.int32))
+    x = jnp.asarray(np.array([5000, -5000, 0, 0, 0], np.int32))
+    ang, _ = fxp.cordic_atan2(y, x)
+    ref = np.array([0, 32768, 16384, -16384, 0])
+    d = (np.asarray(ang) - ref + 32768) % 65536 - 32768
+    assert np.abs(d).max() <= 16
+
+
+@pytest.mark.parametrize("kinv_bits,scale,tol_rel", [(15, 2e4, 2e-3),
+                                                     (10, 3e5, 6e-3)])
+def test_cordic_rotate_accuracy(kinv_bits, scale, tol_rel):
+    rng = np.random.default_rng(2)
+    v = (rng.normal(size=(4000, 2)) * scale).astype(np.int32)
+    ang = rng.integers(-32768, 32768, 4000).astype(np.int32)
+    got = np.asarray(fxp.cordic_rotate(jnp.asarray(v), jnp.asarray(ang),
+                                       kinv_bits=kinv_bits))
+    th = ang * np.pi / 32768
+    want = np.stack([v[:, 0] * np.cos(th) - v[:, 1] * np.sin(th),
+                     v[:, 0] * np.sin(th) + v[:, 1] * np.cos(th)], -1)
+    err = np.hypot(*(got - want).T)
+    assert (err <= np.maximum(16, tol_rel * np.hypot(v[:, 0], v[:, 1]))
+            ).all()
+
+
+def test_dft64_matches_fft():
+    rng = np.random.default_rng(3)
+    x = (rng.normal(size=(5, 64, 2)) * 8000).astype(np.int32)
+    got = np.asarray(fxp.dft64_q14(jnp.asarray(x), shift=7), np.float64)
+    xc = x[..., 0] + 1j * x[..., 1]
+    want = np.fft.fft(xc, axis=-1)
+    err = np.abs((got[..., 0] + 1j * got[..., 1]) - want)
+    # Q14 twiddle quantization over a 64-term sum
+    assert err.max() <= 4 + 2e-4 * np.abs(want).max()
+
+
+def test_primitives_bit_identical_jit_eager_vmap():
+    rng = np.random.default_rng(4)
+    v = (rng.normal(size=(64, 2)) * 2e4).astype(np.int32)
+    a = rng.integers(-32768, 32768, 64).astype(np.int32)
+    rot_e = fxp.cordic_rotate(jnp.asarray(v), jnp.asarray(a))
+    rot_j = jax.jit(fxp.cordic_rotate)(jnp.asarray(v), jnp.asarray(a))
+    rot_v = jax.vmap(fxp.cordic_rotate)(
+        jnp.asarray(v.reshape(8, 8, 2)),
+        jnp.asarray(a.reshape(8, 8))).reshape(64, 2)
+    np.testing.assert_array_equal(np.asarray(rot_e), np.asarray(rot_j))
+    np.testing.assert_array_equal(np.asarray(rot_e), np.asarray(rot_v))
+
+
+# ----------------------------------------------------------- end to end
+
+def _clean_case(mbps, n_bytes, seed):
+    rate = RATES[mbps]
+    rng = np.random.default_rng(seed)
+    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+    frame = np.asarray(tx.encode_frame(psdu, mbps))
+    return rate, psdu, frame, n_symbols(n_bytes, rate)
+
+
+@pytest.mark.parametrize("mbps", [6, 9, 12, 18, 24, 36, 48, 54])
+def test_fxp_decodes_clean_frame_all_rates(mbps):
+    rate, psdu, frame, n_sym = _clean_case(mbps, 120, seed=10 + mbps)
+    fq = rx_fxp.quantize_frame(frame)
+    got, _sv = rx_fxp.decode_data_fxp(fq, rate, n_sym, 8 * 120)
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(bytes_to_bits(psdu)))
+
+
+def test_fxp_decodes_impaired_frame_like_float():
+    # multipath + CFO + noise at operating SNR, frame pre-aligned by the
+    # float sync (acquisition stays float; the fxp boundary is the
+    # aligned frame) — fxp and float interiors must agree on the PSDU
+    for mbps, seed in ((24, 71), (54, 72)):
+        rate = RATES[mbps]
+        n_bytes = 100
+        psdu, cap = channel.impaired_capture(mbps, n_bytes, seed=seed)
+        res = rx.receive(np.asarray(cap))
+        assert res.ok
+        want = np.asarray(bytes_to_bits(np.asarray(psdu, np.uint8)))
+        # re-align exactly as receive() did, then hand the fxp path the
+        # same aligned region. The capture is complex16 wire format at
+        # scale 1024; the fxp boundary assumes unit average power
+        # (AGC), so normalize before quantizing.
+        x = np.asarray(cap, np.float32) / 1024.0
+        found, start, eps = rx.sync_frame(jnp.asarray(x))
+        assert bool(np.asarray(found))
+        n_sym = n_symbols(n_bytes, rate)
+        need = rx.FRAME_DATA_START + 80 * n_sym
+        from ziria_tpu.ops import sync as sync_mod
+        seg = sync_mod.correct_cfo(
+            jnp.asarray(x[int(start): int(start) + need]),
+            float(np.asarray(eps)))
+        fq = rx_fxp.quantize_frame(seg)
+        got, _sv = rx_fxp.decode_data_fxp(fq, rate, n_sym, 8 * n_bytes)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_fxp_bit_identical_across_jit_and_vmap_width():
+    """The contract the module exists for: same quantized input ->
+    bit-identical LLRs and bits, eager vs jit, batch of 1 vs batch of
+    4, and batched rows vs per-frame runs."""
+    rate, psdu, frame, n_sym = _clean_case(24, 80, seed=30)
+    noisy = frame + np.random.default_rng(31).normal(
+        scale=0.05, size=frame.shape).astype(np.float32)
+    fq = np.asarray(rx_fxp.quantize_frame(noisy))
+
+    llr_e = np.asarray(rx_fxp.decode_front_fxp(
+        jnp.asarray(fq), rate, n_sym))
+    llr_j = np.asarray(jax.jit(
+        lambda f: rx_fxp.decode_front_fxp(f, rate, n_sym))(
+            jnp.asarray(fq)))
+    np.testing.assert_array_equal(llr_e, llr_j)
+
+    batch = np.stack([fq, fq, fq, fq])
+    llr_b = np.asarray(jax.vmap(
+        lambda f: rx_fxp.decode_front_fxp(f, rate, n_sym))(
+            jnp.asarray(batch)))
+    for row in llr_b:
+        np.testing.assert_array_equal(row, llr_e)
+
+    bits1, _ = rx_fxp.decode_data_fxp(jnp.asarray(fq), rate, n_sym,
+                                      8 * 80)
+    bitsb, _ = rx_fxp.decode_data_batch_fxp(jnp.asarray(batch), rate,
+                                            n_sym, 8 * 80)
+    for row in np.asarray(bitsb):
+        np.testing.assert_array_equal(row, np.asarray(bits1))
+    np.testing.assert_array_equal(np.asarray(bits1),
+                                  np.asarray(bytes_to_bits(psdu)))
+
+
+def test_fxp_llrs_track_float_llrs():
+    """Directional sanity: fxp LLR signs agree with float LLRs on
+    essentially every coded bit of a noisy frame (quantization may
+    flip near-zero soft values only)."""
+    rate, _psdu, frame, n_sym = _clean_case(54, 100, seed=40)
+    noisy = frame + np.random.default_rng(41).normal(
+        scale=0.03, size=frame.shape).astype(np.float32)
+    dep_f = np.asarray(rx._decode_front(
+        jnp.asarray(noisy, jnp.float32), rate, n_sym)).reshape(-1)
+    dep_q = np.asarray(rx_fxp.decode_front_fxp(
+        rx_fxp.quantize_frame(noisy), rate, n_sym),
+        np.float64).reshape(-1)
+    # compare where the float LLR is not tiny (true erasure positions
+    # from depuncture are 0 in both)
+    big = np.abs(dep_f) > 0.05 * np.abs(dep_f).max()
+    agree = (np.sign(dep_f[big]) == np.sign(dep_q[big])).mean()
+    assert agree > 0.999

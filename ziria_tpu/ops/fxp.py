@@ -1,0 +1,230 @@
+"""Q15 fixed-point DSP primitives: the integer compute core of the
+fixed-point RX interior (phy/wifi/rx_fxp.py).
+
+Counterpart of the reference's fixed-point SORA bricks (SURVEY.md §2.2:
+`csrc/ext_math.c`, the SSE FFT, and the fixed-point demapper inside the
+RX chain): the reference ran its whole PHY in int16 "complex16" math
+with LUT trig. This module rebuilds that discipline TPU-first:
+
+- all arithmetic is int32 adds/muls/shifts on (..., 2) IQ pairs —
+  every op is exact, so results are **bit-identical across backends,
+  jit/interp, and vmap widths** (the property the f32 path cannot
+  promise, and the reason a fixed-point interior exists at all);
+- the DFT is an integer *matmul* against split Q14 twiddles (hi/lo
+  int8-range factors, two int32 GEMMs) — the MXU-native formulation of
+  a fixed-point FFT, not a butterfly network;
+- trig is pure-integer CORDIC (vectoring for atan2/magnitude, rotation
+  for derotation) — ext_math.atan2_int16 routes through f32 arctan2,
+  which is NOT bit-stable across backends, so the fixed-point receiver
+  cannot use it.
+
+Number formats (documented per function): int16 at API boundaries,
+int32 inside; shifts use round-half-up (`rsra`), the single rounding
+rule of the whole module.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+I16 = jnp.int16
+
+Q15_HALF_TURN = 32768          # int16 turn angle units per pi radians
+CORDIC_ITERS = 16              # gain K = prod sqrt(1 + 2^-2i) ~ 1.64676
+
+# atan(2^-i) in Q15 turn units (host-side table; exact integers)
+_CORDIC_ANGLES = np.round(
+    np.arctan(2.0 ** -np.arange(CORDIC_ITERS))
+    * (Q15_HALF_TURN / np.pi)).astype(np.int32)
+
+
+def rsra(x, s: int):
+    """Rounding arithmetic right shift (round half up): the module's
+    one rounding rule. s == 0 is the identity."""
+    x = jnp.asarray(x, I32)
+    if s == 0:
+        return x
+    return (x + (1 << (s - 1))) >> s
+
+
+def sat16(x):
+    """Saturate int32 to the int16 range (stays int32 dtype)."""
+    return jnp.clip(jnp.asarray(x, I32), -32768, 32767)
+
+
+def quantize_q(x, frac_bits: int):
+    """Float -> int32 Q(frac_bits) with round-half-up + int16
+    saturation. The fixed-point boundary for float-domain captures."""
+    x = jnp.asarray(x, jnp.float32)
+    return sat16(jnp.floor(x * (1 << frac_bits) + 0.5).astype(I32))
+
+
+# --------------------------------------------------------------- CORDIC
+
+def cordic_atan2(y, x):
+    """Pure-integer CORDIC vectoring: Q15 turn angle of (y, x).
+
+    Inputs int32 with |x|,|y| <= 2^26 (growth x1.647 must stay inside
+    int32). Returns (angle_q15 int32 in [-32768, 32767],
+    magnitude int32 ~= 1.6467 * sqrt(x^2 + y^2)).
+    Angle error <= ~2 Q15 steps; exactly reproducible everywhere.
+    """
+    x = jnp.asarray(x, I32)
+    y = jnp.asarray(y, I32)
+    # quadrant fold: CORDIC converges for |angle| <= ~0.55 half-turns
+    neg_x = x < 0
+    z0 = jnp.where(neg_x & (y >= 0), I32(Q15_HALF_TURN),
+                   jnp.where(neg_x, I32(-Q15_HALF_TURN), I32(0)))
+    x0 = jnp.where(neg_x, -x, x)
+    y0 = jnp.where(neg_x, -y, y)
+
+    def body(i, c):
+        xc, yc, zc = c
+        d_pos = yc >= 0                       # rotate towards y == 0
+        xs, ys = xc >> i, yc >> i
+        a = _ANGLES_J[i]
+        xn = jnp.where(d_pos, xc + ys, xc - ys)
+        yn = jnp.where(d_pos, yc - xs, yc + xs)
+        zn = jnp.where(d_pos, zc + a, zc - a)
+        return xn, yn, zn
+
+    xf, _yf, zf = jax.lax.fori_loop(0, CORDIC_ITERS, body, (x0, y0, z0))
+    # wrap into the int16 turn range (z can reach +-(32768 + eps));
+    # the degenerate (0, 0) input has no angle — pin it to 0 (the
+    # iterations above would otherwise sum the whole angle table)
+    zf = ((zf + Q15_HALF_TURN) & 0xFFFF) - Q15_HALF_TURN
+    zf = jnp.where((x == 0) & (y == 0), 0, zf)
+    return zf, xf
+
+
+def cordic_rotate(pair, angle_q15, kinv_bits: int = 15):
+    """Pure-integer CORDIC rotation of IQ `pair` (..., 2) by a Q15 turn
+    angle (broadcastable to pair[..., 0]).
+
+    The x1.6467 CORDIC gain is compensated up front by the
+    Q(kinv_bits) reciprocal; the compensation multiply is the input
+    limit: |re|,|im| < 2^31 / ceil(2^kinv_bits / 1.6467). kinv_bits=15
+    (default) allows ~2^16.7 inputs at ~3e-5 gain error; kinv_bits=10
+    allows ~2^21.7 at ~8e-4 — callers pick headroom vs precision.
+    Result is the rotated input at unchanged scale; worst-case error
+    ~1e-3 relative (angle-table rounding) + the gain-reciprocal error."""
+    p = jnp.asarray(pair, I32)
+    a = jnp.asarray(angle_q15, I32)
+    kinv = I32(int(round((1 << kinv_bits) / 1.646760258121)))
+    # pre-compensate the gain while magnitudes are smallest
+    x = rsra(p[..., 0] * kinv, kinv_bits)
+    y = rsra(p[..., 1] * kinv, kinv_bits)
+    # quadrant fold to the convergence range
+    big = jnp.abs(a) > (Q15_HALF_TURN // 2)
+    x = jnp.where(big, -x, x)
+    y = jnp.where(big, -y, y)
+    z = jnp.where(big, a - jnp.sign(a) * Q15_HALF_TURN, a)
+
+    def body(i, c):
+        xc, yc, zc = c
+        d_pos = zc >= 0                       # rotate residual to zero
+        xs, ys = xc >> i, yc >> i
+        ang = _ANGLES_J[i]
+        xn = jnp.where(d_pos, xc - ys, xc + ys)
+        yn = jnp.where(d_pos, yc + xs, yc - xs)
+        zn = jnp.where(d_pos, zc - ang, zc + ang)
+        return xn, yn, zn
+
+    xf, yf, _zf = jax.lax.fori_loop(0, CORDIC_ITERS, body, (x, y, z))
+    return jnp.stack([xf, yf], axis=-1)
+
+
+_ANGLES_J = jnp.asarray(_CORDIC_ANGLES)
+
+
+# ------------------------------------------------- integer DFT (matmul)
+
+def _dft_twiddles_q14(n: int):
+    """DFT matrix exp(-2*pi*i*j*k/n) in Q14, split into (hi, lo) int
+    factors with W == hi * 128 + lo, each factor in int8 range — the
+    two-GEMM trick that keeps a 64-term int32 accumulation inside
+    int32 (64 * 2^15 * 2^14 would need 36 bits unsplit)."""
+    jk = np.outer(np.arange(n), np.arange(n))
+    w = np.exp(-2j * np.pi * jk / n)
+    wq = np.round(w.real * (1 << 14)).astype(np.int32), \
+        np.round(w.imag * (1 << 14)).astype(np.int32)
+    out = []
+    for m in wq:
+        hi = m >> 7                       # arithmetic: lo in [0, 127]
+        lo = m - (hi << 7)
+        out.append((hi.astype(np.int32), lo.astype(np.int32)))
+    return out  # [(re_hi, re_lo), (im_hi, im_lo)]
+
+
+_TW64 = _dft_twiddles_q14(64)
+
+
+def _gemm_q14(x, hi, lo):
+    """x (..., 64) int32 @ split-Q14 matrix -> int32, result scaled by
+    2^-7 (the lo half is rounded in, then the hi half is added at its
+    natural 2^7 weight): (x @ hi) + rsra(x @ lo, 7)."""
+    dot = lambda a, b: jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=I32)
+    return dot(x, hi) + rsra(dot(x, lo), 7)
+
+
+def dft64_q14(pair, shift: int = 7):
+    """Integer 64-point DFT of int IQ pairs (..., 64, 2) via four int32
+    GEMMs against split Q14 twiddles.
+
+    Input |values| <= 2^15 (int16-range). Output = DFT(x) * 2^(7-shift)
+    (the twiddle Q14 scale minus the internal 2^-7, minus `shift` more
+    rounding bits). shift=7 returns the unnormalized DFT at input
+    scale: bins = sum_n x[n] w^(nk) exactly (to the documented
+    rounding)."""
+    p = jnp.asarray(pair, I32)
+    xr, xi = p[..., 0], p[..., 1]
+    (rh, rl), (ih, il) = _TW64_J
+    re = _gemm_q14(xr, rh, rl) - _gemm_q14(xi, ih, il)
+    im = _gemm_q14(xr, ih, il) + _gemm_q14(xi, rh, rl)
+    return jnp.stack([rsra(re, shift), rsra(im, shift)], axis=-1)
+
+
+_TW64_J = tuple(
+    (jnp.asarray(h), jnp.asarray(l)) for h, l in _TW64)
+
+
+# ------------------------------------------------------ pair arithmetic
+
+def cmul_conj_i32(a, b, shift: int):
+    """a * conj(b) for int IQ pairs, each product rsra'd by `shift`
+    BEFORE the add so intermediates stay in int32 when
+    |a|*|b| <= 2^30."""
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    re = rsra(ar * br, shift) + rsra(ai * bi, shift)
+    im = rsra(ai * br, shift) - rsra(ar * bi, shift)
+    return jnp.stack([re, im], axis=-1)
+
+
+def cabs2_i32(p, shift: int):
+    """|p|^2 for int IQ pairs with the same pre-add rounding shift."""
+    return (rsra(p[..., 0] * p[..., 0], shift)
+            + rsra(p[..., 1] * p[..., 1], shift))
+
+
+def isqrt_u32(x):
+    """Integer floor square root of non-negative int32 (bitwise
+    restoring method, 16 fixed iterations — exact)."""
+    x = jnp.asarray(x, I32)
+
+    def body(i, c):
+        rem, res = c
+        bit = I32(1) << (30 - 2 * i)
+        take = rem >= res + bit
+        rem = jnp.where(take, rem - (res + bit), rem)
+        res = jnp.where(take, (res >> 1) + bit, res >> 1)
+        return rem, res
+
+    _rem, root = jax.lax.fori_loop(0, 16, body,
+                                   (x, jnp.zeros_like(x)))
+    return root

@@ -1,0 +1,180 @@
+"""Fixed-point (Q15/complex16-style) 802.11a DATA decode interior.
+
+The reference RX ran its whole steady-state chain in int16 fixed point
+(SORA bricks, SURVEY.md §2.2-2.3); this framework's RX interior is
+deliberately f32 (docs/language.md) — EXCEPT here. This module is the
+ROADMAP §3 option made real: a division-free integer decode path whose
+every op is exact int32 arithmetic, so its output is **bit-identical
+across backends, jit vs interp, and vmap widths**. That reproducibility
+is the fixed-point path's reason to exist (the f32 path only promises
+tolerance-bounded equality; see tests/test_rx_fxp.py).
+
+Design (classic fixed-point receiver, restructured for the VPU):
+
+- the aligned, CFO-corrected frame is quantized to Q11 int16 IQ
+  (`quantize_frame`), the fixed-point boundary;
+- the 64-pt FFT is `ops/fxp.dft64_q14` — integer GEMMs against split
+  Q14 twiddles (the MXU formulation of SORA's SSE FFT);
+- **no zero-forcing division**: instead of eq = y / H we carry
+  z = y * conj(H) and demap against thresholds scaled by G = |H|^2 —
+  algebraically the same LLRs the float path computes (its demapper
+  multiplies by the gain |H|^2 right back; demap.py:47), with the
+  divide gone;
+- pilot common-phase tracking is integer CORDIC: vectoring recovers
+  the pilot phase, rotation derotates the data bins. The pilot sum
+  weights each pilot by its subcarrier gain G_k (a maximal-ratio
+  combine) where the float path weights uniformly — documented
+  intentional divergence, same operating behavior;
+- LLRs leave as int16; the Viterbi ACS on exact small integers in f32
+  is itself exact (|metric spread| << 2^24), so the decoded bits —
+  and therefore descramble/CRC — inherit bit-exactness end to end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ziria_tpu.ops import coding, fxp, interleave, ofdm, scramble, \
+    viterbi, viterbi_pallas
+from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RateParams
+from ziria_tpu.phy.wifi.rx import FRAME_DATA_START
+
+Q_IN = 11              # input quantization: Q11 (4 bits of PAPR headroom)
+_DFT_SHIFT = 10        # dft64_q14 shift: bins ~= DFT * 2^-3 of Q11 input
+_Z_SHIFT = 4           # pre-add shift inside y*conj(H) and |H|^2
+_W_SHIFT = 3           # working shift down to demap precision
+# overflow audit (Q11 input, |H| <= 4, 64-QAM corners): bins <= 2^16,
+# z products <= 2^27, zw <= 2^20.5, zw * NORM_Q7 <= 2^30.2 — all int32
+LLR_SHIFT = 5          # int32 LLR -> int16 output scale
+
+# level-domain norm constants (demap.py _NORM) in Q7
+_NORM_Q7 = {1: 1 << 7, 2: int(round(np.sqrt(2.0) * 128)),
+            4: int(round(np.sqrt(10.0) * 128)),
+            6: int(round(np.sqrt(42.0) * 128))}
+
+
+def quantize_frame(frame_f32):
+    """Float aligned frame (..., 2) -> int32-held Q11 int16 samples."""
+    return fxp.quantize_q(frame_f32, Q_IN)
+
+
+def _fft_bins(sym_pairs):
+    """(..., 80, 2) int Q11 time samples -> (..., 64, 2) int bins
+    (CP stripped; unnormalized DFT scaled 2^-3)."""
+    return fxp.dft64_q14(sym_pairs[..., ofdm.N_CP:, :], shift=_DFT_SHIFT)
+
+
+def _estimate_channel_q(frame_q):
+    """Integer channel estimate from the two LTS symbols: bin average
+    times the known +-1 reference — same scale as the data bins."""
+    l1 = fxp.dft64_q14(frame_q[192:256], shift=_DFT_SHIFT)
+    l2 = fxp.dft64_q14(frame_q[256:320], shift=_DFT_SHIFT)
+    avg = fxp.rsra(l1 + l2, 1)
+    ref = np.zeros(ofdm.N_FFT, np.int32)
+    ref[(np.arange(-26, 27) % ofdm.N_FFT)] = \
+        ofdm.LTS_FREQ.astype(np.int32)
+    return avg * jnp.asarray(ref)[:, None]
+
+
+def _demap_q(i_lvl, gw, n_bpsc: int):
+    """Level-domain max-log LLRs, all-integer: i_lvl ~ lvl * Gw where
+    Gw is the per-subcarrier gain; thresholds are multiples of Gw
+    (demap.py level formulas with |H|^2 folded through)."""
+    if n_bpsc in (1, 2):
+        return i_lvl[..., None] if n_bpsc == 1 else i_lvl
+    a = jnp.abs(i_lvl)
+    if n_bpsc == 4:
+        return jnp.stack([i_lvl, 2 * gw - a], axis=-1)
+    return jnp.stack([i_lvl, 4 * gw - a,
+                      2 * gw - jnp.abs(a - 4 * gw)], axis=-1)
+
+
+def decode_front_fxp(frame_q, rate: RateParams, n_sym: int):
+    """Quantized aligned frame -> depunctured int16 LLR pairs (T, 2).
+
+    The integer mirror of rx._decode_front: channel est + integer
+    GEMM-FFT + conj-multiply 'equalize' + CORDIC pilot derotation +
+    gain-scaled demap + deinterleave + depuncture."""
+    frame_q = jnp.asarray(frame_q, fxp.I32)
+    H = _estimate_channel_q(frame_q)                       # (64, 2)
+    syms = frame_q[FRAME_DATA_START: FRAME_DATA_START + 80 * n_sym]
+    bins = _fft_bins(syms.reshape(n_sym, 80, 2))           # (n_sym, 64, 2)
+
+    # division-free equalize: z = y * conj(H), gain G = |H|^2, both at
+    # working precision
+    z = fxp.cmul_conj_i32(bins, H, _Z_SHIFT)
+    zw = fxp.rsra(z, _W_SHIFT)
+    G = fxp.cabs2_i32(H, _Z_SHIFT)                         # (64,)
+    gw = fxp.rsra(G, _W_SHIFT)
+
+    data = zw[:, jnp.asarray(ofdm.DATA_BINS)]              # (n_sym, 48, 2)
+    pilots = zw[:, jnp.asarray(ofdm.PILOT_BINS)]           # (n_sym, 4, 2)
+    g_data = gw[jnp.asarray(ofdm.DATA_BINS)]               # (48,)
+
+    # pilot common phase, symbol polarity applied; CORDIC vectoring.
+    # (z already carries G_k per pilot: a gain-weighted pilot sum.)
+    pol = jnp.asarray(np.rint(ofdm.PILOT_POLARITY).astype(np.int32))[
+        (jnp.arange(n_sym) + 1) % 127]
+    expect = jnp.asarray(np.rint(ofdm.PILOT_VALS).astype(np.int32))
+    w = pol[:, None] * expect[None, :]                     # (n_sym, 4)
+    p = (pilots * w[..., None]).sum(axis=-2)               # (n_sym, 2)
+    ang, _mag = fxp.cordic_atan2(p[..., 1], p[..., 0])     # (n_sym,)
+
+    # derotate every data bin by -phase (kinv_bits=10: zw reaches
+    # ~2^20.5 at |H|=4, above the Q15-compensation input limit)
+    data = fxp.cordic_rotate(data, -ang[:, None], kinv_bits=10)
+
+    # level scale: i_lvl ~= lvl * Gw via the Q7 norm constant
+    cn = fxp.I32(_NORM_Q7[rate.n_bpsc])
+    i_lvl = fxp.rsra(data[..., 0] * cn, 7)
+    q_lvl = fxp.rsra(data[..., 1] * cn, 7)
+    gvec = jnp.broadcast_to(g_data, i_lvl.shape)
+    if rate.n_bpsc == 1:
+        llr = _demap_q(i_lvl, gvec, 1)
+    else:
+        half = rate.n_bpsc // 2
+        llr = jnp.concatenate(
+            [_demap_q(i_lvl, gvec, rate.n_bpsc).reshape(
+                i_lvl.shape + (half,)),
+             _demap_q(q_lvl, gvec, rate.n_bpsc).reshape(
+                 q_lvl.shape + (half,))], axis=-1)
+    llr16 = fxp.sat16(fxp.rsra(llr.reshape(n_sym, -1), LLR_SHIFT))
+
+    deint = interleave.deinterleave(
+        llr16.reshape(-1), rate.n_cbps, rate.n_bpsc)
+    return coding.depuncture(deint, rate.coding, fill=0).reshape(-1, 2)
+
+
+def decode_data_fxp(frame_q, rate: RateParams, n_sym: int,
+                    n_psdu_bits: int):
+    """Quantized aligned frame -> (psdu_bits, service_bits), all-integer
+    front end + exact-integer-in-f32 Viterbi + descramble."""
+    dep = decode_front_fxp(frame_q, rate, n_sym)
+    bits = viterbi.viterbi_decode(
+        dep.astype(jnp.float32), n_bits=n_sym * rate.n_dbps)
+    seed = scramble.recover_seed(bits[:7])
+    clear = scramble.descramble_bits(bits, seed)
+    return (clear[N_SERVICE_BITS: N_SERVICE_BITS + n_psdu_bits],
+            clear[:N_SERVICE_BITS])
+
+
+def decode_data_batch_fxp(frames_q, rate: RateParams, n_sym: int,
+                          n_psdu_bits: int, interpret: bool = None):
+    """Batched integer decode: (B, frame_len, 2) int -> ((B, n), (B, 16)).
+    Same lane layout as rx.decode_data_batch: vmapped integer front
+    end, Pallas Viterbi across the batch."""
+    dep = jax.vmap(
+        lambda f: decode_front_fxp(f, rate, n_sym))(frames_q)
+    bits = viterbi_pallas.viterbi_decode_batch(
+        dep.astype(jnp.float32), n_bits=n_sym * rate.n_dbps,
+        interpret=interpret)
+
+    def back(b):
+        seed = scramble.recover_seed(b[:7])
+        clear = scramble.descramble_bits(b, seed)
+        return (clear[N_SERVICE_BITS: N_SERVICE_BITS + n_psdu_bits],
+                clear[:N_SERVICE_BITS])
+
+    return jax.vmap(back)(bits)
