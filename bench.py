@@ -162,7 +162,15 @@ def _setup():
     from ziria_tpu.utils.bits import bytes_to_bits
 
     rate = RATES[54]
-    n_bytes = 1000
+    # ZIRIA_BENCH_NBYTES shrinks the frame for CPU smoke tests of the
+    # child path; outside smoke mode a leaked override must not
+    # silently change the workload the published number is computed on
+    n_bytes = int(os.environ.get("ZIRIA_BENCH_NBYTES", "1000"))
+    if n_bytes != 1000 and os.environ.get("ZIRIA_BENCH_ALLOW_CPU") != "1":
+        raise RuntimeError(
+            f"ZIRIA_BENCH_NBYTES={n_bytes} is only valid in smoke mode "
+            "(ZIRIA_BENCH_ALLOW_CPU=1): the headline metric is defined "
+            "on the 1000-byte frame")
     n_sym = n_symbols(n_bytes, rate)
     n_psdu_bits = 8 * n_bytes
     frame_len = 400 + 80 * n_sym
@@ -255,6 +263,12 @@ def _child_main(run_id):
     t0 = time.time()
     import jax
     import jax.numpy as jnp
+    if os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1":
+        # smoke mode MUST stay off the tunnel: JAX_PLATFORMS env is
+        # ignored by the axon plugin; only a config update before
+        # backend init actually pins the child to CPU (same mechanism
+        # as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
     _enable_compile_cache()
     note("jax imported; touching backend")
     devs = jax.devices()
@@ -262,10 +276,16 @@ def _child_main(run_id):
     note(f"backend up: {dev.platform} / {getattr(dev, 'device_kind', '?')}"
          f" x{len(devs)}")
     if dev.platform == "cpu":
-        # a CPU fallback must NOT be reported as a per-chip number —
-        # fail so the parent records tpu: unavailable instead
-        note("backend is CPU, not a TPU — refusing to fake a chip metric")
-        sys.exit(3)
+        if os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1":
+            # smoke-test mode: exercises the full child path on CPU;
+            # the parent still refuses platform=="cpu" results, so
+            # this can never masquerade as a chip number
+            note("CPU allowed for smoke test (ZIRIA_BENCH_ALLOW_CPU=1)")
+        else:
+            # a CPU fallback must NOT be reported as a per-chip number —
+            # fail so the parent records tpu: unavailable instead
+            note("backend is CPU, not a TPU — refusing to fake a chip metric")
+            sys.exit(3)
     _partial(run_id, "backend_up", platform=dev.platform,
              device_kind=getattr(dev, "device_kind", "?"))
 
@@ -305,26 +325,87 @@ def _child_main(run_id):
         return jax.lax.fori_loop(
             0, k, body, (jnp.float32(0), jnp.int32(0)))[1]
 
-    def timed_k(k, tries=3):
+    def timed_k(f, k, tries=3):
         best = float("inf")
-        _block(decode_k(frames, jnp.int32(k)))      # compile + warm
+        _block(decode_k(f, jnp.int32(k)))      # compile + warm
         for _ in range(tries):
-            t0 = time.perf_counter()
-            _block(decode_k(frames, jnp.int32(k)))
-            best = min(best, time.perf_counter() - t0)
+            ts = time.perf_counter()
+            _block(decode_k(f, jnp.int32(k)))
+            best = min(best, time.perf_counter() - ts)
         return best
 
+    def emit_headline(stage, b, t, method):
+        """One definition of a measured-throughput partial record, so
+        the headline, sweep probes, and promotion can't drift apart."""
+        _partial(run_id, stage, tpu_sps=b * frame_len / t, t_step_s=t,
+                 batch=b, platform=dev.platform,
+                 device_kind=getattr(dev, "device_kind", "?"),
+                 timing_method=method,
+                 roofline=_roofline(b, frame_len, n_sym, n_psdu_bits, t))
+
     K1, K2 = 32, 160
-    t1, t2 = timed_k(K1), timed_k(K2)
+    t1, t2 = timed_k(frames, K1), timed_k(frames, K2)
     t_tpu = (t2 - t1) / (K2 - K1)
     sps = B * frame_len / t_tpu
+    timing_method = f"marginal device-loop step (K={K1} vs {K2})"
     note(f"device-loop: K={K1}: {t1*1e3:.1f} ms, K={K2}: {t2*1e3:.1f} ms"
          f" -> marginal {t_tpu*1e3:.3f} ms/step")
-    _partial(run_id, "headline", tpu_sps=sps, t_step_s=t_tpu, batch=B,
-             platform=dev.platform,
-             device_kind=getattr(dev, "device_kind", "?"),
-             timing_method=f"marginal device-loop step (K={K1} vs {K2})",
-             roofline=_roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu))
+    emit_headline("headline", B, t_tpu, timing_method)
+
+    # Batch-width sweep: the B=128 headline leaves the chip ~96% idle
+    # (roofline above) — the decode is dependency-chain-bound, so wider
+    # batches are nearly free until a VMEM/HBM cliff. Measure wider
+    # widths with the same marginal methodology and promote the best
+    # to the headline. Each width is one fresh compile of decode_k;
+    # its result is recorded as a partial before the next compile
+    # starts, so a flapping tunnel keeps whatever was measured.
+    # ZIRIA_BENCH_SWEEP=0 pins the headline at B=128.
+    sweep = {B: t_tpu}
+    if os.environ.get("ZIRIA_BENCH_SWEEP", "1") != "0":
+        Ks1, Ks2 = 8, 40
+        for Bs in (256, 512):
+            if time.time() - t0 > 900:
+                note(f"sweep: out of time budget before B={Bs}")
+                break
+            try:
+                fs = jnp.asarray(
+                    np.broadcast_to(frame, (Bs,) + frame.shape).copy())
+                # row-0 correctness ride-along: decode_k's accumulator
+                # sums bits[0, 0] over k iterations of the real decode
+                acc = int(decode_k(fs, jnp.int32(4)))
+                assert acc == 4 * int(want[0]), (acc, int(want[0]))
+                ts1, ts2 = timed_k(fs, Ks1), timed_k(fs, Ks2)
+                t_b = (ts2 - ts1) / (Ks2 - Ks1)
+                # plausibility: a step over MORE frames cannot take
+                # less absolute time than the B=128 step (80% slack
+                # for noise) — the sweep's K-spread is only 32 steps,
+                # and a congested-window glitch there must not
+                # publish an inflated headline
+                if t_b < 0.8 * t_tpu:
+                    note(f"sweep: B={Bs} marginal {t_b*1e3:.3f} ms "
+                         f"implausible (< B=128's {t_tpu*1e3:.3f} ms)"
+                         f" — discarded")
+                    continue
+                sweep[Bs] = t_b
+                note(f"sweep: B={Bs} marginal {t_b*1e3:.3f} ms/step"
+                     f" ({Bs * frame_len / t_b / 1e6:.0f} M sps)")
+                emit_headline(
+                    "batch_sweep", Bs, t_b,
+                    f"marginal device-loop step (K={Ks1} vs {Ks2}), "
+                    f"batch sweep probe")
+            except Exception as e:
+                note(f"sweep: B={Bs} failed: {e!r}")
+                break
+        B_best = max(sweep, key=lambda b: b * frame_len / sweep[b])
+        if B_best != B:
+            B, t_tpu = B_best, sweep[B_best]
+            sps = B * frame_len / t_tpu
+            timing_method = (f"marginal device-loop step (K={Ks1} vs "
+                             f"{Ks2}), best of batch sweep "
+                             f"{sorted(sweep)}")
+            note(f"sweep: promoting B={B} to headline"
+                 f" ({sps/1e6:.0f} M sps)")
+            emit_headline("headline", B, t_tpu, timing_method)
 
     # Pallas-on-Mosaic proof: decode with interpret=False explicitly and
     # compare to the lax.scan oracle. On a real TPU this compiles the
@@ -332,13 +413,17 @@ def _child_main(run_id):
     from ziria_tpu.ops import viterbi, viterbi_pallas
     rng = np.random.default_rng(1)
     llrs = jnp.asarray(rng.normal(size=(4, 1024, 2)).astype(np.float32))
-    hard = viterbi_pallas.viterbi_decode_batch(llrs, interpret=False)
+    # interpret=False means Mosaic — except in the CPU smoke mode,
+    # where Pallas has no backend and interpret mode stands in
+    hard = viterbi_pallas.viterbi_decode_batch(
+        llrs, interpret=(dev.platform == "cpu"))
     oracle = jax.vmap(viterbi.viterbi_decode)(llrs)
     assert np.array_equal(np.asarray(hard), np.asarray(oracle)), \
         "Pallas (Mosaic) Viterbi != lax.scan oracle"
-    pallas_mosaic = True
-    note("Pallas kernels compiled by Mosaic, match oracle")
-    _partial(run_id, "pallas_mosaic", pallas_mosaic=True)
+    pallas_mosaic = dev.platform != "cpu"
+    note("Pallas kernels compiled by Mosaic, match oracle"
+         if pallas_mosaic else "Pallas kernels in interpret mode (smoke)")
+    _partial(run_id, "pallas_mosaic", pallas_mosaic=pallas_mosaic)
 
     # Frame batching on-chip (r4): any compiled .zir program amortizes
     # the host link across frames — 16 captures through the in-language
@@ -383,7 +468,9 @@ def _child_main(run_id):
         note(f"framebatch stage failed: {e!r}")
         fb = {"error": repr(e)}
 
-    # per-call diagnostic (tunnel-dispatch-bound upper bound on latency)
+    # per-call diagnostic (tunnel-dispatch-bound upper bound on
+    # latency) — always taken at the base batch of 128, which may
+    # differ from the promoted headline batch; recorded as such
     t_percall = _time(decode, frames, reps=50)
     note(f"t_marginal={t_tpu*1e3:.3f} ms t_percall={t_percall*1e3:.3f} ms")
 
@@ -416,9 +503,12 @@ def _child_main(run_id):
         "tpu_sps": sps,
         "t_step_s": t_tpu,
         "t_percall_s": t_percall,
+        "t_percall_batch": 128,
         "fence_audit_bur_over_copy": fence_audit,
-        "timing_method": f"marginal device-loop step (K={K1} vs {K2})",
+        "timing_method": timing_method,
         "batch": B,
+        "frame_bytes": n_psdu_bits // 8,
+        "batch_sweep": {str(b): round(t, 6) for b, t in sorted(sweep.items())},
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
         "pallas_mosaic": pallas_mosaic,
@@ -477,8 +567,10 @@ def _probe(deadline):
 
 
 def _recover_partial(run_id):
-    """Pull the headline stage out of BENCH_PARTIAL.jsonl for this run
-    (the child was killed after measuring but before printing)."""
+    """Pull the best measured stage out of BENCH_PARTIAL.jsonl for this
+    run (the child was killed after measuring but before printing).
+    "Best" = highest tpu_sps: batch-sweep partials also carry tpu_sps,
+    and a slower sweep width must not shadow the recorded headline."""
     try:
         best = None
         with open(PARTIAL_PATH) as f:
@@ -487,7 +579,9 @@ def _recover_partial(run_id):
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if rec.get("run_id") == run_id and "tpu_sps" in rec:
+                if (rec.get("run_id") == run_id and "tpu_sps" in rec
+                        and (best is None
+                             or rec["tpu_sps"] >= best["tpu_sps"])):
                     best = rec
         return best
     except OSError:
@@ -677,12 +771,20 @@ def main():
         if err and child is None:
             print(f"[bench] {err}", file=sys.stderr, flush=True)
 
+    if child is not None and child.get("platform") == "cpu":
+        # a smoke-mode child (ZIRIA_BENCH_ALLOW_CPU leaked into a real
+        # run) must never publish CPU throughput as a per-chip number
+        err = "child ran on cpu (smoke mode leaked?) — result refused"
+        child = None
+
     if child is not None:
         result["value"] = round(child["tpu_sps"], 1)
         result["vs_baseline"] = round(child["tpu_sps"] / sps_np, 3)
         for k in ("platform", "device_kind", "batch", "t_step_s",
-                  "t_percall_s", "fence_audit_bur_over_copy",
-                  "timing_method", "pallas_mosaic", "roofline", "partial"):
+                  "t_percall_s", "t_percall_batch",
+                  "fence_audit_bur_over_copy",
+                  "timing_method", "pallas_mosaic", "roofline",
+                  "batch_sweep", "framebatch", "frame_bytes", "partial"):
             if k in child:
                 result[k] = child.get(k)
         if err:

@@ -158,6 +158,10 @@ def main() -> None:
 
     os.makedirs(GOLD, exist_ok=True)
     only = set(sys.argv[1:])          # regenerate a subset by name
+    unknown = only - {name for name, *_ in CASES}
+    if unknown:
+        raise SystemExit(f"unknown case name(s): {sorted(unknown)}; "
+                         f"known: {sorted(n for n, *_ in CASES)}")
     for name, in_ty, make, mode in CASES:
         if only and name not in only:
             continue
