@@ -48,7 +48,7 @@ LIVE_PATH = os.path.join(REPO, "BENCH_LIVE.json")
 PROBE_TIMEOUT = 90
 PROBE_TRIES = 2
 PROBE_BACKOFF = 15
-CHILD_TIMEOUT_MAX = 480
+CHILD_TIMEOUT_MAX = 700   # raised for the batch sweep's extra compiles
 
 # v5e single-chip peaks for the roofline sanity line.
 V5E_HBM_GBPS = 819.0
@@ -261,6 +261,10 @@ def _child_main(run_id):
               file=sys.stderr, flush=True)
 
     t0 = time.time()
+    # the kill budget the parent will enforce on this process — stage
+    # guards below are fractions of it, so they actually fire
+    budget = float(os.environ.get("BENCH_CHILD_BUDGET",
+                                  str(CHILD_TIMEOUT_MAX)))
     import jax
     import jax.numpy as jnp
     if os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1":
@@ -313,15 +317,38 @@ def _child_main(run_id):
     # honest samples/sec/chip is the *marginal* time of one decode step
     # inside a jitted fori_loop, taken between two loop lengths to
     # cancel the fixed round-trip.
+    # integrity checksum folded into the timed loop: a lane- and
+    # bit-position-weighted reduction of the decoded bits, masked to 20
+    # bits so the accumulator cannot overflow. Catches decode
+    # corruption in ANY lane/bit at ANY width (the earlier ride-along
+    # watched a single bit of lane 0), at a cost negligible relative to
+    # the decode — and identical across widths, keeping the sweep fair.
+    CHK_MASK = (1 << 20) - 1
+
+    def _chk_expected(b, k):
+        i = np.arange(b, dtype=np.int64)[:, None]
+        j = np.arange(want.size, dtype=np.int64)[None, :]
+        w = (i * 131 + j * 7) % 17 - 8
+        one = int((w * want.astype(np.int64)).sum())
+        acc = 0
+        for _ in range(k):
+            acc = (acc + one) & CHK_MASK
+        return acc
+
     @jax.jit
     def decode_k(f, k):
         # traced loop bound -> ONE compile serves every K
-        def body(i, carry):
+        i = jnp.arange(f.shape[0], dtype=jnp.int32)[:, None]
+        j = jnp.arange(n_psdu_bits, dtype=jnp.int32)[None, :]
+        chk_w = (i * 131 + j * 7) % 17 - 8
+
+        def body(_i, carry):
             s, acc = carry
             x = f + s * 1e-30            # loop-carried: no hoisting
             bits = rx.decode_data_batch(x, rate, n_sym, n_psdu_bits)[0]
+            chk = (bits.astype(jnp.int32) * chk_w).sum()
             return (bits.astype(jnp.float32).sum() * 1e-30,
-                    acc + bits[0, 0].astype(jnp.int32))
+                    (acc + chk) & CHK_MASK)
         return jax.lax.fori_loop(
             0, k, body, (jnp.float32(0), jnp.int32(0)))[1]
 
@@ -350,7 +377,32 @@ def _child_main(run_id):
     timing_method = f"marginal device-loop step (K={K1} vs {K2})"
     note(f"device-loop: K={K1}: {t1*1e3:.1f} ms, K={K2}: {t2*1e3:.1f} ms"
          f" -> marginal {t_tpu*1e3:.3f} ms/step")
+    # verify the loop body's decode BEFORE the record exists: a failed
+    # checksum must leave nothing for partial recovery to publish
+    a128 = int(decode_k(frames, jnp.int32(2)))
+    assert a128 == _chk_expected(B, 2), (a128, _chk_expected(B, 2))
     emit_headline("headline", B, t_tpu, timing_method)
+
+    # Pallas-on-Mosaic proof: decode with interpret=False explicitly and
+    # compare to the lax.scan oracle. On a real TPU this compiles the
+    # kernels with Mosaic; any Mosaic rejection fails loudly here.
+    # Ordered BEFORE the batch sweep: this is load-bearing round
+    # evidence and must land even if the sweep eats the remaining
+    # child budget.
+    from ziria_tpu.ops import viterbi, viterbi_pallas
+    rng = np.random.default_rng(1)
+    llrs = jnp.asarray(rng.normal(size=(4, 1024, 2)).astype(np.float32))
+    # interpret=False means Mosaic — except in the CPU smoke mode,
+    # where Pallas has no backend and interpret mode stands in
+    hard = viterbi_pallas.viterbi_decode_batch(
+        llrs, interpret=(dev.platform == "cpu"))
+    oracle = jax.vmap(viterbi.viterbi_decode)(llrs)
+    assert np.array_equal(np.asarray(hard), np.asarray(oracle)), \
+        "Pallas (Mosaic) Viterbi != lax.scan oracle"
+    pallas_mosaic = dev.platform != "cpu"
+    note("Pallas kernels compiled by Mosaic, match oracle"
+         if pallas_mosaic else "Pallas kernels in interpret mode (smoke)")
+    _partial(run_id, "pallas_mosaic", pallas_mosaic=pallas_mosaic)
 
     # Batch-width sweep: the B=128 headline leaves the chip ~96% idle
     # (roofline above) — the decode is dependency-chain-bound, so wider
@@ -364,16 +416,20 @@ def _child_main(run_id):
     if os.environ.get("ZIRIA_BENCH_SWEEP", "1") != "0":
         Ks1, Ks2 = 8, 40
         for Bs in (256, 512):
-            if time.time() - t0 > 900:
+            # guard on the REAL kill budget the parent runs us under
+            # (review: a constant above the parent's hard timeout can
+            # never fire and every harvest died mid-aux as a partial)
+            if time.time() - t0 > 0.55 * budget:
                 note(f"sweep: out of time budget before B={Bs}")
                 break
             try:
                 fs = jnp.asarray(
                     np.broadcast_to(frame, (Bs,) + frame.shape).copy())
-                # row-0 correctness ride-along: decode_k's accumulator
-                # sums bits[0, 0] over k iterations of the real decode
+                # integrity ride-along at this width: the weighted
+                # whole-batch checksum, not one bit of lane 0
                 acc = int(decode_k(fs, jnp.int32(4)))
-                assert acc == 4 * int(want[0]), (acc, int(want[0]))
+                assert acc == _chk_expected(Bs, 4), \
+                    (acc, _chk_expected(Bs, 4))
                 ts1, ts2 = timed_k(fs, Ks1), timed_k(fs, Ks2)
                 t_b = (ts2 - ts1) / (Ks2 - Ks1)
                 # plausibility: a step over MORE frames cannot take
@@ -407,30 +463,14 @@ def _child_main(run_id):
                  f" ({sps/1e6:.0f} M sps)")
             emit_headline("headline", B, t_tpu, timing_method)
 
-    # Pallas-on-Mosaic proof: decode with interpret=False explicitly and
-    # compare to the lax.scan oracle. On a real TPU this compiles the
-    # kernels with Mosaic; any Mosaic rejection fails loudly here.
-    from ziria_tpu.ops import viterbi, viterbi_pallas
-    rng = np.random.default_rng(1)
-    llrs = jnp.asarray(rng.normal(size=(4, 1024, 2)).astype(np.float32))
-    # interpret=False means Mosaic — except in the CPU smoke mode,
-    # where Pallas has no backend and interpret mode stands in
-    hard = viterbi_pallas.viterbi_decode_batch(
-        llrs, interpret=(dev.platform == "cpu"))
-    oracle = jax.vmap(viterbi.viterbi_decode)(llrs)
-    assert np.array_equal(np.asarray(hard), np.asarray(oracle)), \
-        "Pallas (Mosaic) Viterbi != lax.scan oracle"
-    pallas_mosaic = dev.platform != "cpu"
-    note("Pallas kernels compiled by Mosaic, match oracle"
-         if pallas_mosaic else "Pallas kernels in interpret mode (smoke)")
-    _partial(run_id, "pallas_mosaic", pallas_mosaic=pallas_mosaic)
-
     # Frame batching on-chip (r4): any compiled .zir program amortizes
     # the host link across frames — 16 captures through the in-language
     # receiver should ride ~the single-frame device-call count. Timed
     # here because the win is exactly the per-call tunnel cost the
     # marginal-step methodology above factors out.
     try:
+        if time.time() - t0 > 0.75 * budget:
+            raise TimeoutError("skipped: child time budget")
         from ziria_tpu.backend import chunked as CH
         from ziria_tpu.backend import hybrid as HY
         from ziria_tpu.backend.framebatch import StepBatcher, run_many
@@ -736,6 +776,9 @@ def main():
                     if budget < 60:
                         err = err or "deadline too close after probe"
                         break
+                    # the child's stage guards key off the REAL kill
+                    # budget, not a guess (inherited environment)
+                    os.environ["BENCH_CHILD_BUDGET"] = str(budget)
                     rc, out, errtxt = _run_one_child(
                         ["--tpu-child", "--run-id", run_id], budget)
                     if rc == 0:

@@ -40,7 +40,10 @@ print('probe ok:', d.platform, d.device_kind)
 " >> "$LOG" 2>&1; then
     probe_log ok
     echo "[watcher] probe ok $(date -u +%H:%M:%S); running bench" >> "$LOG"
-    timeout -k 15 1500 env TPU_BUSY_HELD=1 python bench.py > /root/repo/BENCH_LIVE.json.tmp 2>> "$LOG"
+    # self-deadline below the hard timeout so the parent can give the
+    # child the full CHILD_TIMEOUT_MAX and still retry once
+    timeout -k 15 1500 env TPU_BUSY_HELD=1 BENCH_SELF_DEADLINE=1400 \
+      python bench.py > /root/repo/BENCH_LIVE.json.tmp 2>> "$LOG"
     rc=$?
     echo "[watcher] bench rc=$rc" >> "$LOG"
     if [ $rc -eq 0 ] && python -c "
