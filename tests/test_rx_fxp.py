@@ -175,6 +175,25 @@ def test_fxp_bit_identical_across_jit_and_vmap_width():
                                   np.asarray(bytes_to_bits(psdu)))
 
 
+def test_receive_fxp_switch():
+    """receive(fxp=True): the full host driver with the integer DATA
+    interior — same PSDU as the float path on impaired captures,
+    including FCS validation."""
+    for mbps, seed in ((12, 81), (54, 82)):
+        psdu, cap = channel.impaired_capture(mbps, 80, seed=seed,
+                                             add_fcs=True)
+        res_f = rx.receive(np.asarray(cap, np.float32), check_fcs=True)
+        res_q = rx.receive(np.asarray(cap, np.float32), check_fcs=True,
+                           fxp=True)
+        assert res_f.ok and res_q.ok
+        assert res_q.crc_ok and res_f.crc_ok
+        assert res_q.rate_mbps == mbps
+        np.testing.assert_array_equal(res_q.psdu_bits, res_f.psdu_bits)
+        np.testing.assert_array_equal(
+            res_q.psdu_bits[: 8 * 80],
+            np.asarray(bytes_to_bits(np.asarray(psdu, np.uint8))))
+
+
 def test_fxp_llrs_track_float_llrs():
     """Directional sanity: fxp LLR signs agree with float LLRs on
     essentially every coded bit of a noisy frame (quantization may

@@ -14,9 +14,9 @@ with LUT trig. This module rebuilds that discipline TPU-first:
   int8-range factors, two int32 GEMMs) — the MXU-native formulation of
   a fixed-point FFT, not a butterfly network;
 - trig is pure-integer CORDIC (vectoring for atan2/magnitude, rotation
-  for derotation) — ext_math.atan2_int16 routes through f32 arctan2,
-  which is NOT bit-stable across backends, so the fixed-point receiver
-  cannot use it.
+  for derotation); ext_math.atan2_int16 delegates to the vectoring
+  kernel here, so the DSL's fixed-point atan2 shares the same
+  backend-bit-stable implementation.
 
 Number formats (documented per function): int16 at API boundaries,
 int32 inside; shifts use round-half-up (`rsra`), the single rounding

@@ -202,12 +202,20 @@ def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
 
 
 @lru_cache(maxsize=None)
-def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int):
+def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
+                              fxp: bool = False):
     rate = RATES[rate_mbps]
 
-    def f(frame, n_bits_real):
-        return decode_data_bucketed(frame, rate, n_sym_bucket,
-                                    n_bits_real)
+    if fxp:
+        from ziria_tpu.phy.wifi import rx_fxp
+
+        def f(frame_q, n_bits_real):
+            return rx_fxp.decode_data_bucketed_fxp(
+                frame_q, rate, n_sym_bucket, n_bits_real)
+    else:
+        def f(frame, n_bits_real):
+            return decode_data_bucketed(frame, rate, n_sym_bucket,
+                                        n_bits_real)
 
     return jax.jit(f)
 
@@ -223,13 +231,21 @@ _jit_signal = None
 
 
 def receive(samples, check_fcs: bool = False,
-            max_samples: int = 1 << 16) -> RxResult:
+            max_samples: int = 1 << 16, fxp: bool = False) -> RxResult:
     """Host-side receiver driver: detect, align, CFO-correct, parse
     SIGNAL, dispatch the per-rate decoder — the jit analogue of the
     reference's header-driven rate dispatch. The data decode compiles
     once per (rate, power-of-two symbol bucket) with the true bit count
     traced (see decode_data_bucketed), so varied traffic stays within
     O(rates x log lengths) compiles.
+
+    fxp=True routes the DATA decode through the Q15 integer interior
+    (phy/wifi/rx_fxp.py — the reference's fixed-point discipline):
+    acquisition and SIGNAL stay f32; the aligned data region is
+    AGC-normalized by the preamble RMS and quantized to Q11 at the
+    fixed-point boundary, after which every decode op is exact integer
+    arithmetic (bit-identical across backends for identical quantized
+    input).
     """
     global _jit_sync, _jit_signal
     if _jit_sync is None:
@@ -284,7 +300,14 @@ def receive(samples, check_fcs: bool = False,
     frame_pad = np.zeros((need_b, 2), np.float32)
     frame_pad[:min(avail, need_b)] = frame_np[:min(avail, need_b)]
     seg = sync.correct_cfo(jnp.asarray(frame_pad), eps)
-    dec = _jit_decode_data_bucketed(rate_mbps, n_sym_b)
+    if fxp:
+        from ziria_tpu.phy.wifi import rx_fxp
+        # AGC at the fixed-point boundary: unit average power over the
+        # real preamble (numpy host math — stable for a given capture)
+        rms = float(np.sqrt(np.mean(frame_np[:320].astype(np.float64)
+                                    ** 2) * 2.0))
+        seg = rx_fxp.quantize_frame(np.asarray(seg) / max(rms, 1e-12))
+    dec = _jit_decode_data_bucketed(rate_mbps, n_sym_b, fxp)
     clear = np.asarray(
         dec(seg, jnp.int32(n_sym * rate.n_dbps)), np.uint8)
     psdu = clear[N_SERVICE_BITS: N_SERVICE_BITS + 8 * length_bytes]

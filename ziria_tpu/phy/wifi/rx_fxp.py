@@ -160,6 +160,25 @@ def decode_data_fxp(frame_q, rate: RateParams, n_sym: int,
             clear[:N_SERVICE_BITS])
 
 
+def decode_data_bucketed_fxp(frame_q, rate: RateParams,
+                             n_sym_bucket: int, n_bits_real):
+    """Bucketed fixed-point DATA decode (rx.decode_data_bucketed's
+    integer twin): `frame_q` is quantized and padded to
+    FRAME_DATA_START + 80*n_sym_bucket samples, `n_bits_real` is the
+    true data-bit count as a TRACED scalar. LLR rows at or beyond
+    n_bits_real are zeroed (0 = exact erasure in integer land too),
+    so the pad adds no likelihood. Returns the full descrambled
+    stream; the caller slices the PSDU."""
+    dep = decode_front_fxp(frame_q, rate, n_sym_bucket)
+    t = jnp.arange(dep.shape[0])
+    dep = jnp.where((t < n_bits_real)[:, None], dep, 0)
+    bits = viterbi.viterbi_decode(
+        dep.astype(jnp.float32),
+        n_bits=n_sym_bucket * rate.n_dbps)
+    seed = scramble.recover_seed(bits[:7])
+    return scramble.descramble_bits(bits, seed)
+
+
 def decode_data_batch_fxp(frames_q, rate: RateParams, n_sym: int,
                           n_psdu_bits: int, interpret: bool = None):
     """Batched integer decode: (B, frame_len, 2) int -> ((B, n), (B, 16)).
