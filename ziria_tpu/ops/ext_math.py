@@ -13,8 +13,8 @@ in int16 Q-format, not doubles. TPU-first re-design:
   by a 1024-entry quarter-resolution LUT gather (VMEM-resident, the
   TPU analogue of SORA's table) — gathers vectorize over any shape;
 - `atan2_int16` returns the Q15 turn angle from int16 (y, x) — used by
-  pilot phase tracking; implemented in f32 on the VPU then quantized,
-  bit-deviation bounded by the Q15 step;
+  pilot phase tracking; pure-integer CORDIC (ops/fxp), bit-identical
+  on every backend;
 - `usqrt`/`ulog2` integer helpers mirror the reference's integer math.
 
 All functions are jnp-traceable (usable inside jit/scan/vmap) and are
@@ -97,19 +97,24 @@ def sincos_int16(a):
 
 
 # --------------------------------------------------------------------------
-# atan2 (f32 compute, Q15 quantized result)
+# atan2 (pure-integer CORDIC, Q15 result)
 # --------------------------------------------------------------------------
 
 
 def atan2_int16(y, x):
-    """Q15 turn angle of (y, x) — int16 in, int16 out."""
+    """Q15 turn angle of (y, x) — int16 in, int16 out.
+
+    Pure-integer CORDIC vectoring (ops/fxp.cordic_atan2), so the result
+    is bit-identical on every backend — an f32 arctan2 differs by ulps
+    between CPU and TPU, which can flip the quantized angle by one
+    step. Inputs are pre-scaled by 2^10 (angle-invariant) so shift
+    truncation stays below the Q15 step even for short vectors; error
+    vs exact atan2 is < ~1e-3 rad over int16 magnitudes >= ~30."""
     jnp = _jnp()
-    th = jnp.arctan2(jnp.asarray(y, jnp.float32),
-                     jnp.asarray(x, jnp.float32))
-    q = jnp.round(th * (_Q15_PI / np.float32(np.pi)))
-    # +π maps to -32768 (same angle mod 2π), keeping int16 range exact
-    q = jnp.where(q >= 32768.0, -32768.0, q)
-    return q.astype(jnp.int16)
+    from ziria_tpu.ops import fxp
+    ang, _mag = fxp.cordic_atan2(jnp.asarray(y, jnp.int32) << 10,
+                                 jnp.asarray(x, jnp.int32) << 10)
+    return ang.astype(jnp.int16)
 
 
 # --------------------------------------------------------------------------
