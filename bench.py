@@ -335,29 +335,39 @@ def _child_main(run_id):
             acc = (acc + one) & CHK_MASK
         return acc
 
-    @jax.jit
-    def decode_k(f, k):
-        # traced loop bound -> ONE compile serves every K
-        i = jnp.arange(f.shape[0], dtype=jnp.int32)[:, None]
-        j = jnp.arange(n_psdu_bits, dtype=jnp.int32)[None, :]
-        chk_w = (i * 131 + j * 7) % 17 - 8
+    def make_decode_k(decode_rows):
+        """Jitted K-step device loop around `decode_rows` ((B, len, 2)
+        -> (B, n_psdu_bits) bits) with the integrity checksum — ONE
+        definition shared by the f32 and fxp paths so their timing
+        methodology and corruption detection cannot drift apart."""
+        @jax.jit
+        def dk(f, k):
+            # traced loop bound -> ONE compile serves every K
+            i = jnp.arange(f.shape[0], dtype=jnp.int32)[:, None]
+            j = jnp.arange(n_psdu_bits, dtype=jnp.int32)[None, :]
+            chk_w = (i * 131 + j * 7) % 17 - 8
 
-        def body(_i, carry):
-            s, acc = carry
-            x = f + s * 1e-30            # loop-carried: no hoisting
-            bits = rx.decode_data_batch(x, rate, n_sym, n_psdu_bits)[0]
-            chk = (bits.astype(jnp.int32) * chk_w).sum()
-            return (bits.astype(jnp.float32).sum() * 1e-30,
-                    (acc + chk) & CHK_MASK)
-        return jax.lax.fori_loop(
-            0, k, body, (jnp.float32(0), jnp.int32(0)))[1]
+            def body(_i, carry):
+                s, acc = carry
+                bits = decode_rows(f + s)    # s is 0 at runtime but
+                chk = (bits.astype(jnp.int32) * chk_w).sum()
+                # bits are 0/1 so b>>1 == 0, yet data-dependent: the
+                # next iteration's input cannot be hoisted
+                return (bits[0, 0].astype(jnp.int32) >> 1,
+                        (acc + chk) & CHK_MASK)
+            return jax.lax.fori_loop(
+                0, k, body, (jnp.int32(0), jnp.int32(0)))[1]
+        return dk
 
-    def timed_k(f, k, tries=3):
+    decode_k = make_decode_k(
+        lambda x: rx.decode_data_batch(x, rate, n_sym, n_psdu_bits)[0])
+
+    def timed_k(dk, f, k, tries=3):
         best = float("inf")
-        _block(decode_k(f, jnp.int32(k)))      # compile + warm
+        _block(dk(f, jnp.int32(k)))            # compile + warm
         for _ in range(tries):
             ts = time.perf_counter()
-            _block(decode_k(f, jnp.int32(k)))
+            _block(dk(f, jnp.int32(k)))
             best = min(best, time.perf_counter() - ts)
         return best
 
@@ -371,7 +381,7 @@ def _child_main(run_id):
                  roofline=_roofline(b, frame_len, n_sym, n_psdu_bits, t))
 
     K1, K2 = 32, 160
-    t1, t2 = timed_k(frames, K1), timed_k(frames, K2)
+    t1, t2 = timed_k(decode_k, frames, K1), timed_k(decode_k, frames, K2)
     t_tpu = (t2 - t1) / (K2 - K1)
     sps = B * frame_len / t_tpu
     timing_method = f"marginal device-loop step (K={K1} vs {K2})"
@@ -430,7 +440,8 @@ def _child_main(run_id):
                 acc = int(decode_k(fs, jnp.int32(4)))
                 assert acc == _chk_expected(Bs, 4), \
                     (acc, _chk_expected(Bs, 4))
-                ts1, ts2 = timed_k(fs, Ks1), timed_k(fs, Ks2)
+                ts1, ts2 = (timed_k(decode_k, fs, Ks1),
+                            timed_k(decode_k, fs, Ks2))
                 t_b = (ts2 - ts1) / (Ks2 - Ks1)
                 # plausibility: a step over MORE frames cannot take
                 # less absolute time than the B=128 step (80% slack
@@ -508,6 +519,48 @@ def _child_main(run_id):
         note(f"framebatch stage failed: {e!r}")
         fb = {"error": repr(e)}
 
+    # Fixed-point interior on-chip (r4 session 3): the Q15 integer
+    # decode (phy/wifi/rx_fxp.py) timed with the same marginal-step
+    # methodology at B=128 — evidence of what the reference's int16
+    # discipline costs/earns on the VPU vs the f32 fast path.
+    # Non-fatal, budget-guarded.
+    fxp_ev = None
+    try:
+        if time.time() - t0 > 0.85 * budget:
+            raise TimeoutError("skipped: child time budget")
+        from ziria_tpu.phy.wifi import rx_fxp
+        fq = rx_fxp.quantize_frame(jnp.asarray(frame))
+        fqs = jnp.broadcast_to(fq, (128,) + fq.shape)
+        decode_k_fxp = make_decode_k(
+            lambda x: rx_fxp.decode_data_batch_fxp(
+                x, rate, n_sym, n_psdu_bits)[0])
+
+        acc = int(decode_k_fxp(fqs, jnp.int32(2)))
+        assert acc == _chk_expected(128, 2), \
+            (acc, _chk_expected(128, 2))
+
+        tf1 = timed_k(decode_k_fxp, fqs, 8)
+        tf2 = timed_k(decode_k_fxp, fqs, 40)
+        t_fxp = (tf2 - tf1) / 32
+        t128 = sweep.get(128, t_tpu)
+        # plausibility (same reasoning as the sweep's guard): an fxp
+        # step 5x faster than the f32 step is a timing glitch on the
+        # 32-step K-spread, not physics
+        if not t_fxp > 0.2 * t128:
+            raise RuntimeError(
+                f"implausible fxp marginal {t_fxp*1e3:.3f} ms "
+                f"(f32 step {t128*1e3:.3f} ms) — timing glitch")
+        fxp_ev = {"t_step_s": round(t_fxp, 6), "batch": 128,
+                  "sps": round(128 * frame_len / t_fxp, 1),
+                  "vs_f32_interior": round(t_fxp / t128, 3)}
+        note(f"fxp interior: {t_fxp*1e3:.3f} ms/step "
+             f"({fxp_ev['sps']/1e6:.0f} M sps, "
+             f"{fxp_ev['vs_f32_interior']:.2f}x the f32 step)")
+        _partial(run_id, "fxp_interior", **fxp_ev)
+    except Exception as e:              # evidence stage: never fatal
+        note(f"fxp stage failed: {e!r}")
+        fxp_ev = {"error": repr(e)}
+
     # per-call diagnostic (tunnel-dispatch-bound upper bound on
     # latency) — always taken at the base batch of 128, which may
     # differ from the promoted headline batch; recorded as such
@@ -553,6 +606,7 @@ def _child_main(run_id):
         "device_kind": getattr(dev, "device_kind", "?"),
         "pallas_mosaic": pallas_mosaic,
         "framebatch": fb,
+        "fxp_interior": fxp_ev,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
     }
     _partial(run_id, "complete", **out)
@@ -827,7 +881,8 @@ def main():
                   "t_percall_s", "t_percall_batch",
                   "fence_audit_bur_over_copy",
                   "timing_method", "pallas_mosaic", "roofline",
-                  "batch_sweep", "framebatch", "frame_bytes", "partial"):
+                  "batch_sweep", "framebatch", "fxp_interior",
+                  "frame_bytes", "partial"):
             if k in child:
                 result[k] = child.get(k)
         if err:

@@ -194,6 +194,37 @@ def test_receive_fxp_switch():
             np.asarray(bytes_to_bits(np.asarray(psdu, np.uint8))))
 
 
+def test_fxp_ber_matches_float_at_operating_point():
+    """Statistical agreement (the BER-waterfall suite's discipline
+    applied to the integer interior): over a batch of AWGN frames at
+    the 54 Mbps operating SNR, the fxp path's bit errors stay within
+    a small absolute gap of the float path's (quantization loss only,
+    no systematic degradation)."""
+    mbps, snr_db, n_frames, n_bytes = 54, 26.0, 16, 100
+    rate = RATES[mbps]
+    n_sym = n_symbols(n_bytes, rate)
+    rng = np.random.default_rng(90)
+    psdus = rng.integers(0, 256, (n_frames, n_bytes)).astype(np.uint8)
+    frames = jnp.stack([tx.encode_frame(p, mbps) for p in psdus])
+    key = jax.random.PRNGKey(91)
+    noisy = jax.vmap(
+        lambda k, f: channel.awgn(k, f, snr_db))(
+            jax.random.split(key, n_frames), frames)
+    want = np.stack([np.asarray(bytes_to_bits(p)) for p in psdus])
+
+    got_f, _ = rx.decode_data_batch(noisy, rate, n_sym, 8 * n_bytes)
+    ber_f = float(np.mean(np.asarray(got_f) != want))
+
+    fq = jax.vmap(rx_fxp.quantize_frame)(noisy)
+    got_q, _ = rx_fxp.decode_data_batch_fxp(fq, rate, n_sym,
+                                            8 * n_bytes)
+    ber_q = float(np.mean(np.asarray(got_q) != want))
+    # operating point: float is (near-)clean; fxp may add only
+    # quantization-level losses
+    assert ber_f <= 1e-3
+    assert ber_q <= ber_f + 2e-3, (ber_q, ber_f)
+
+
 def test_fxp_llrs_track_float_llrs():
     """Directional sanity: fxp LLR signs agree with float LLRs on
     essentially every coded bit of a noisy frame (quantization may
