@@ -111,7 +111,8 @@ def main() -> int:
 
     from ziria_tpu.core.vectorize import vectorize
 
-    report = {"device": str(dev), "pipelines": {}}
+    report = {"device": str(dev), "platform": dev.platform,
+              "pipelines": {}}
     for name, comp in _pipelines():
         plan = vectorize(comp)
         pick = plan.segments[0].width if plan.segments else 1

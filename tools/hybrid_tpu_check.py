@@ -33,10 +33,17 @@ def main() -> int:
     # already-initialized backend) so a CPU smoke run refuses fast
     # instead of touching (and possibly hanging on) the axon backend
     from ziria_tpu.runtime.cli import _apply_platform
-    _apply_platform(None)
+    # ZIRIA_TOOL_ALLOW_CPU=1: run the whole check body on CPU so a
+    # broken tool cannot waste a real TPU window; the emitted record
+    # is labelled platform=cpu and the watcher only keeps TPU results
+    smoke = os.environ.get("ZIRIA_TOOL_ALLOW_CPU") == "1"
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        _apply_platform(None)
 
     dev = jax.devices()[0]
-    if dev.platform == "cpu":
+    if dev.platform == "cpu" and not smoke:
         print(json.dumps({"ok": False, "error": "backend is CPU"}))
         return 1
 

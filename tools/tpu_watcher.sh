@@ -39,7 +39,35 @@ assert d.platform != 'cpu', d.platform
 print('probe ok:', d.platform, d.device_kind)
 " >> "$LOG" 2>&1; then
     probe_log ok
-    echo "[watcher] probe ok $(date -u +%H:%M:%S); running bench" >> "$LOG"
+    echo "[watcher] probe ok $(date -u +%H:%M:%S)" >> "$LOG"
+    # MISSING ARTIFACTS FIRST: a round-4 headline already exists in
+    # BENCH_LIVE.json, so a short window is worth more spent on the
+    # three still-missing calibration artifacts (three-round ask)
+    # than on a bench re-harvest that happens every cycle anyway.
+    # Each harvest strips ZIRIA_TOOL_ALLOW_CPU (a leaked smoke env
+    # must not run the tools on CPU) AND verifies the record's
+    # platform before promoting it — CPU output is never published.
+    harvest() {  # harvest <tool.py> <target.json> <timeout_s>
+      [ -s "$2" ] && return 0
+      touch /tmp/tpu_busy   # refresh: bench.py treats >35min-old flags as leaked
+      if timeout -k 15 "$3" env -u ZIRIA_TOOL_ALLOW_CPU \
+           python "$1" > "$2.tmp" 2>> "$LOG" \
+         && python -c "
+import json, sys
+j = json.load(open('$2.tmp'))
+sys.exit(0 if j.get('platform') not in (None, 'cpu') else 1)
+" 2>> "$LOG"; then
+        mv "$2.tmp" "$2"
+        echo "[watcher] $(basename "$1") ok" >> "$LOG"
+      else
+        echo "[watcher] $(basename "$1") failed" >> "$LOG"
+      fi
+    }
+    harvest tools/calibrate_vect.py /root/repo/VECT_CALIB.json 1500
+    harvest tools/hybrid_tpu_check.py /root/repo/HYBRID_TPU.json 900
+    harvest tools/viterbi_batch_sweep.py /root/repo/VITERBI_SWEEP.json 900
+    echo "[watcher] running bench $(date -u +%H:%M:%S)" >> "$LOG"
+    touch /tmp/tpu_busy
     # self-deadline below the hard timeout so the parent can give the
     # child the full CHILD_TIMEOUT_MAX and still retry once
     timeout -k 15 1500 env TPU_BUSY_HELD=1 BENCH_SELF_DEADLINE=1400 \
@@ -52,32 +80,7 @@ j = json.load(open('/root/repo/BENCH_LIVE.json.tmp'))
 sys.exit(0 if j.get('platform') not in (None,'cpu') else 1)
 " 2>> "$LOG"; then
       mv /root/repo/BENCH_LIVE.json.tmp /root/repo/BENCH_LIVE.json
-      echo "[watcher] bench SUCCESS $(date -u +%H:%M:%S)" >> "$LOG"
-      if [ ! -s /root/repo/VECT_CALIB.json ]; then
-        touch /tmp/tpu_busy   # refresh: bench.py treats >35min-old flags as leaked
-        timeout -k 15 1800 python tools/calibrate_vect.py \
-          > /root/repo/VECT_CALIB.json.tmp 2>> "$LOG" \
-          && mv /root/repo/VECT_CALIB.json.tmp /root/repo/VECT_CALIB.json \
-          && echo "[watcher] calib ok" >> "$LOG" \
-          || echo "[watcher] calib failed" >> "$LOG"
-      fi
-      if [ ! -s /root/repo/HYBRID_TPU.json ]; then
-        touch /tmp/tpu_busy
-        timeout -k 15 1800 python tools/hybrid_tpu_check.py \
-          > /root/repo/HYBRID_TPU.json.tmp 2>> "$LOG" \
-          && mv /root/repo/HYBRID_TPU.json.tmp /root/repo/HYBRID_TPU.json \
-          && echo "[watcher] hybrid-on-tpu ok" >> "$LOG" \
-          || echo "[watcher] hybrid-on-tpu failed" >> "$LOG"
-      fi
-      if [ ! -s /root/repo/VITERBI_SWEEP.json ]; then
-        touch /tmp/tpu_busy
-        timeout -k 15 1500 python tools/viterbi_batch_sweep.py \
-          > /root/repo/VITERBI_SWEEP.json.tmp 2>> "$LOG" \
-          && mv /root/repo/VITERBI_SWEEP.json.tmp /root/repo/VITERBI_SWEEP.json \
-          && echo "[watcher] viterbi sweep ok" >> "$LOG" \
-          || echo "[watcher] viterbi sweep failed" >> "$LOG"
-      fi
-      echo "[watcher] CHAIN DONE $(date -u +%H:%M:%S); sleeping 3h" >> "$LOG"
+      echo "[watcher] bench SUCCESS; CHAIN DONE $(date -u +%H:%M:%S); sleeping 3h" >> "$LOG"
       rm -f /tmp/tpu_busy
       sleep 10800
       continue

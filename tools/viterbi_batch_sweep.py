@@ -40,16 +40,27 @@ sys.path.insert(0, os.path.join(os.path.dirname(
 
 def main():
     import jax
+
+    # ZIRIA_TOOL_ALLOW_CPU=1: smoke-test the whole sweep body on CPU
+    # (interpret-mode kernels, shrunk sizes) so a broken tool cannot
+    # waste a real TPU window — the sys.path bug above already cost
+    # one. Results are labelled platform=cpu and never mistakable for
+    # chip evidence.
+    smoke = os.environ.get("ZIRIA_TOOL_ALLOW_CPU") == "1"
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from ziria_tpu.ops import viterbi_pallas as vp
 
     dev = jax.devices()[0]
-    if dev.platform == "cpu":
+    if dev.platform == "cpu" and not smoke:
         print(json.dumps({"error": "no TPU visible"}))
         return 1
+    interp = dev.platform == "cpu"
 
-    T = 8208
+    T = 1040 if smoke else 8208
     rng = np.random.default_rng(0)
     out = {"platform": dev.platform,
            "device_kind": getattr(dev, "device_kind", "?"),
@@ -58,15 +69,15 @@ def main():
     def fence(x):
         np.asarray(x.ravel()[:1])
 
-    for B in (128, 256, 512, 1024):
+    for B in ((128, 256) if smoke else (128, 256, 512, 1024)):
         llrs = jnp.asarray(rng.normal(size=(B, T, 2)).astype(np.float32))
         full = jax.jit(lambda x: vp.viterbi_decode_batch(
-            x, interpret=False))
+            x, interpret=interp))
         # kernel-only: pre-tiled input, no lane transposes in the timed
         # region
         x = jnp.transpose(llrs, (1, 2, 0)).reshape(
             T, 2, B // 128, 128).transpose(2, 0, 1, 3)
-        kern = jax.jit(lambda t: vp._decode_tiles(t, False))
+        kern = jax.jit(lambda t: vp._decode_tiles(t, interp))
 
         def timed(fn, arg, reps=8):
             fence(fn(arg))
