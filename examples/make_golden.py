@@ -82,6 +82,11 @@ CASES = [
     # (HYBRID_CASES) — detection, CFO, SIGNAL parse, rate dispatch and
     # decode all pinned by one file pair
     ("wifi_rx", "complex16", lambda: _rx_capture(24, 60, 119), "bin"),
+    # the FIXED-POINT in-language receiver (--fxp-complex16): same
+    # capture recipe at 36 Mbps; integer detect/CFO/equalize/demap
+    # pinned by the pair, replayed hybrid
+    ("wifi_rx_fxp", "complex16", lambda: _rx_capture(36, 70, 123),
+     "bin"),
     # the multi-rate in-language TRANSMITTER: one 36 Mbps frame,
     # in-band [rate, len, bits...] header (INTERP_CASES — runtime-
     # parameterized whole-frame program)
@@ -132,7 +137,7 @@ def _rx_capture(mbps, n_bytes, seed):
 
 # cases compiled under the fixed-point complex16 policy
 # (--fxp-complex16 on replay)
-FXP_CASES = {"tx_qpsk_fxp"}
+FXP_CASES = {"tx_qpsk_fxp", "wifi_rx_fxp"}
 
 # cases replayed on the interpreter backend (whole-frame programs whose
 # fully-unrolled jit graphs take minutes of XLA compile on CPU)
@@ -144,7 +149,7 @@ AUTOLUT_CASES = {"pack_bits", "lut_map"}
 
 # cases replayed on the hybrid backend (dynamic control; heavy
 # do-blocks jit) — ground truth still comes from the interpreter
-HYBRID_CASES = {"wifi_rx"}
+HYBRID_CASES = {"wifi_rx", "wifi_rx_fxp"}
 
 
 def main() -> None:

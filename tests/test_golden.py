@@ -79,7 +79,7 @@ def test_golden(name, mode, atol, tmp_path):
     rc = cli_main(argv)
     assert rc == 0
 
-    prog = compile_file(src)
+    prog = compile_file(src, fxp_complex16=name in _FXP_CASES)
     got = read_stream(StreamSpec(ty=prog.out_ty, path=str(outf),
                                  mode=mode))
     want = read_stream(StreamSpec(ty=prog.out_ty, path=ground, mode=mode))

@@ -41,6 +41,36 @@ _CORDIC_ANGLES = np.round(
     * (Q15_HALF_TURN / np.pi)).astype(np.int32)
 
 
+def _in_trace() -> bool:
+    """True while some JAX transformation is tracing. Private-API probe
+    with a conservative fallback (assume tracing -> never cache)."""
+    try:
+        import jax._src.core as _core
+        return _core.trace_ctx.trace is not _core.eval_trace
+    except Exception:
+        return True
+
+
+_CONST_CACHE: dict = {}
+
+
+def _const(key, build):
+    """Device-constant memo that is safe against lazy import inside a
+    jit trace: this module can be first imported while the hybrid
+    backend is tracing a do-block (ext resolution is lazy), and values
+    created at that point are trace-scoped — caching one leaks its
+    tracer into every later caller (observed as UnexpectedTracerError
+    from the wifi_rx_fxp golden). So constants are cached only when
+    built OUTSIDE a trace; inside a trace they are rebuilt per call,
+    where they fold into the jaxpr as ordinary constants."""
+    v = _CONST_CACHE.get(key)
+    if v is None:
+        v = build()
+        if not _in_trace():
+            _CONST_CACHE[key] = v
+    return v
+
+
 def rsra(x, s: int):
     """Rounding arithmetic right shift (round half up): the module's
     one rounding rule. s == 0 is the identity."""
@@ -80,12 +110,13 @@ def cordic_atan2(y, x):
                    jnp.where(neg_x, I32(-Q15_HALF_TURN), I32(0)))
     x0 = jnp.where(neg_x, -x, x)
     y0 = jnp.where(neg_x, -y, y)
+    angles = _const("angles", lambda: jnp.asarray(_CORDIC_ANGLES))
 
     def body(i, c):
         xc, yc, zc = c
         d_pos = yc >= 0                       # rotate towards y == 0
         xs, ys = xc >> i, yc >> i
-        a = _ANGLES_J[i]
+        a = angles[i]
         xn = jnp.where(d_pos, xc + ys, xc - ys)
         yn = jnp.where(d_pos, yc - xs, yc + xs)
         zn = jnp.where(d_pos, zc + a, zc - a)
@@ -123,11 +154,13 @@ def cordic_rotate(pair, angle_q15, kinv_bits: int = 15):
     y = jnp.where(big, -y, y)
     z = jnp.where(big, a - jnp.sign(a) * Q15_HALF_TURN, a)
 
+    angles = _const("angles", lambda: jnp.asarray(_CORDIC_ANGLES))
+
     def body(i, c):
         xc, yc, zc = c
         d_pos = zc >= 0                       # rotate residual to zero
         xs, ys = xc >> i, yc >> i
-        ang = _ANGLES_J[i]
+        ang = angles[i]
         xn = jnp.where(d_pos, xc - ys, xc + ys)
         yn = jnp.where(d_pos, yc + xs, yc - xs)
         zn = jnp.where(d_pos, zc - ang, zc + ang)
@@ -135,9 +168,6 @@ def cordic_rotate(pair, angle_q15, kinv_bits: int = 15):
 
     xf, yf, _zf = jax.lax.fori_loop(0, CORDIC_ITERS, body, (x, y, z))
     return jnp.stack([xf, yf], axis=-1)
-
-
-_ANGLES_J = jnp.asarray(_CORDIC_ANGLES)
 
 
 # ------------------------------------------------- integer DFT (matmul)
@@ -183,14 +213,11 @@ def dft64_q14(pair, shift: int = 7):
     rounding)."""
     p = jnp.asarray(pair, I32)
     xr, xi = p[..., 0], p[..., 1]
-    (rh, rl), (ih, il) = _TW64_J
+    (rh, rl), (ih, il) = _const("tw64", lambda: tuple(
+        (jnp.asarray(h), jnp.asarray(l)) for h, l in _TW64))
     re = _gemm_q14(xr, rh, rl) - _gemm_q14(xi, ih, il)
     im = _gemm_q14(xr, ih, il) + _gemm_q14(xi, rh, rl)
     return jnp.stack([rsra(re, shift), rsra(im, shift)], axis=-1)
-
-
-_TW64_J = tuple(
-    (jnp.asarray(h), jnp.asarray(l)) for h, l in _TW64)
 
 
 # ------------------------------------------------------ pair arithmetic
