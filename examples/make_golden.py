@@ -96,6 +96,11 @@ CASES = [
     # across two rates in one stream; output must equal the payload
     # bits exactly (FCS generated TX-side, validated+stripped RX-side)
     ("wifi_loopback", "int32", lambda: _loopback_input(122), "bin"),
+    # the ALL-INTEGER loopback (--fxp-complex16): fcs_add >>>
+    # tx_frame_fxp >>> rx_fxp, zero floating point in the sample
+    # domain on either side
+    ("wifi_loopback_fxp", "int32", lambda: _loopback_input(124),
+     "bin"),
 ]
 
 
@@ -137,11 +142,12 @@ def _rx_capture(mbps, n_bytes, seed):
 
 # cases compiled under the fixed-point complex16 policy
 # (--fxp-complex16 on replay)
-FXP_CASES = {"tx_qpsk_fxp", "wifi_rx_fxp"}
+FXP_CASES = {"tx_qpsk_fxp", "wifi_rx_fxp", "wifi_loopback_fxp"}
 
 # cases replayed on the interpreter backend (whole-frame programs whose
 # fully-unrolled jit graphs take minutes of XLA compile on CPU)
-INTERP_CASES = {"wifi_tx_full", "wifi_tx_rates", "wifi_loopback"}
+INTERP_CASES = {"wifi_tx_full", "wifi_tx_rates", "wifi_loopback",
+                "wifi_loopback_fxp"}
 
 # cases replayed with --autolut: the inferred-LUT rewrite must leave
 # the golden output untouched (flag invariance)

@@ -1,0 +1,69 @@
+"""The ALL-INTEGER in-language loopback (examples/wifi_loopback_fxp.zir):
+fcs_add >>> tx_frame_fxp >>> rx_fxp under --fxp-complex16 — no floating
+point touches a sample on either side, the discipline the reference's
+SORA-backed PHY ran end to end. Payload in must equal payload out, and
+the fixed-point transmitter's air signal must be standard-compliant
+(the FLOAT library receiver decodes it too)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend import hybrid as H
+from ziria_tpu.frontend import compile_file, compile_source
+from ziria_tpu.interp.interp import run
+from ziria_tpu.phy.wifi import rx
+from ziria_tpu.utils.bits import bytes_to_bits
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+SRC = os.path.join(EXAMPLES, "wifi_loopback_fxp.zir")
+
+
+def _frames(pairs, seed):
+    rng = np.random.default_rng(seed)
+    stream, want = [], []
+    for rate, n_bytes in pairs:
+        bits = rng.integers(0, 2, 8 * n_bytes).astype(np.int32)
+        stream += [rate, n_bytes] + bits.tolist()
+        want.append(bits.astype(np.uint8))
+    return [np.int32(v) for v in stream], np.concatenate(want)
+
+
+def test_loopback_fxp_two_frames_interp():
+    prog = compile_file(SRC, fxp_complex16=True)
+    xs, want = _frames(((12, 25), (54, 40)), seed=400)
+    got = np.asarray(run(prog.comp, xs).out_array(), np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_loopback_fxp_hybrid_matches_interp():
+    prog = compile_file(SRC, fxp_complex16=True)
+    hyb = H.hybridize(prog.comp)
+    xs, want = _frames(((24, 30), (48, 35)), seed=401)
+    gi = np.asarray(run(prog.comp, xs).out_array(), np.uint8)
+    gh = np.asarray(run(hyb, xs).out_array(), np.uint8)
+    np.testing.assert_array_equal(gi, want)
+    np.testing.assert_array_equal(gh, want)
+
+
+@pytest.mark.parametrize("rate", [6, 18, 36, 54])
+def test_fxp_tx_air_signal_decodes_under_float_receiver(rate):
+    """Cross-family compliance: the integer transmitter's wire signal
+    is a standard 802.11a frame the f32 LIBRARY receiver decodes."""
+    src = ('#include "lib/wifi_tx_fxp_lib.zir"\n\n'
+           'let comp main = read[int32] >>> repeat { tx_frame_fxp() }'
+           ' >>> write[complex16]\n')
+    prog = compile_source(src, src_name="tx_fxp_probe",
+                          base_dir=EXAMPLES, fxp_complex16=True)
+    rng = np.random.default_rng(410 + rate)
+    n = 40
+    psdu = rng.integers(0, 256, n).astype(np.uint8)
+    bits = np.asarray(bytes_to_bits(psdu)).astype(np.int32)
+    xs = [np.int32(v) for v in [rate, n] + bits.tolist()]
+    x = np.asarray(run(prog.comp, xs).out_array(), np.float32)
+    r = rx.receive(np.concatenate(
+        [np.zeros((50, 2), np.float32), x / 512.0]))
+    assert r.ok and r.rate_mbps == rate
+    np.testing.assert_array_equal(r.psdu_bits,
+                                  np.asarray(bytes_to_bits(psdu)))

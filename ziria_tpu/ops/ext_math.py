@@ -160,6 +160,24 @@ def ulog2(x):
 # --------------------------------------------------------------------------
 
 
+def _ext_c64_to_pair(x):
+    """complex16 ext boundary -> exact int32 IQ pairs (values at the
+    boundary are integer-valued complex64; see dft64_fxp)."""
+    jnp = _jnp()
+    arr = jnp.asarray(x)
+    if jnp.iscomplexobj(arr):
+        return jnp.stack(
+            [jnp.round(arr.real).astype(jnp.int32),
+             jnp.round(arr.imag).astype(jnp.int32)], axis=-1)
+    return jnp.round(arr).astype(jnp.int32)     # pair layout (defensive)
+
+
+def _ext_pair_to_c64(out):
+    jnp = _jnp()
+    return (out[..., 0].astype(jnp.float32)
+            + 1j * out[..., 1].astype(jnp.float32))
+
+
 def dft64_fxp(x):
     """Integer 64-pt DFT brick for fixed-point programs: the fxp
     counterpart of the `v_fft` ext (the reference's SORA FFT was
@@ -174,17 +192,19 @@ def dft64_fxp(x):
     samples give bins of ~2^11.2 per unit bin amplitude — inside
     int16 for channel gains up to ~4x."""
     from ziria_tpu.ops import fxp as _fxp
-    jnp = _jnp()
-    arr = jnp.asarray(x)
-    if jnp.iscomplexobj(arr):
-        pair = jnp.stack(
-            [jnp.round(arr.real).astype(jnp.int32),
-             jnp.round(arr.imag).astype(jnp.int32)], axis=-1)
-    else:                        # pair layout (defensive)
-        pair = jnp.round(arr).astype(jnp.int32)
-    out = _fxp.dft64_q14(pair, shift=10)
-    return (out[..., 0].astype(jnp.float32)
-            + 1j * out[..., 1].astype(jnp.float32))
+    return _ext_pair_to_c64(_fxp.dft64_q14(_ext_c64_to_pair(x),
+                                           shift=10))
+
+
+def idft64_fxp(x):
+    """Integer OFDM symbol synthesis brick for fixed-point programs:
+    inverse DFT with the 802.11 TIME_SCALE/64 folded into the split
+    Q14 twiddles (ops/fxp.idft64_wifi_q14) — integer bins at wire
+    scale in, integer time samples at the same wire scale out.
+    Declared `ext fun idft64_fxp(x: arr[64] complex16) : arr[64]
+    complex16`; exact at the c64 boundary like dft64_fxp."""
+    from ziria_tpu.ops import fxp as _fxp
+    return _ext_pair_to_c64(_fxp.idft64_wifi_q14(_ext_c64_to_pair(x)))
 
 
 def register() -> None:
@@ -196,6 +216,7 @@ def register() -> None:
         ("usqrt", usqrt),
         ("ulog2", ulog2),
         ("dft64_fxp", dft64_fxp),
+        ("idft64_fxp", idft64_fxp),
     ):
         register_external(name, fn)
 

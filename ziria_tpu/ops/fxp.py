@@ -172,13 +172,14 @@ def cordic_rotate(pair, angle_q15, kinv_bits: int = 15):
 
 # ------------------------------------------------- integer DFT (matmul)
 
-def _dft_twiddles_q14(n: int):
-    """DFT matrix exp(-2*pi*i*j*k/n) in Q14, split into (hi, lo) int
-    factors with W == hi * 128 + lo, each factor in int8 range — the
-    two-GEMM trick that keeps a 64-term int32 accumulation inside
-    int32 (64 * 2^15 * 2^14 would need 36 bits unsplit)."""
+def _dft_twiddles_q14(n: int, inverse: bool = False,
+                      scale: float = 1.0):
+    """DFT matrix exp(-+2*pi*i*j*k/n) * scale in Q14, split into
+    (hi, lo) int factors with W == hi * 128 + lo, each factor in int8
+    range — the two-GEMM trick that keeps a 64-term int32 accumulation
+    inside int32 (64 * 2^15 * 2^14 would need 36 bits unsplit)."""
     jk = np.outer(np.arange(n), np.arange(n))
-    w = np.exp(-2j * np.pi * jk / n)
+    w = np.exp((2j if inverse else -2j) * np.pi * jk / n) * scale
     wq = np.round(w.real * (1 << 14)).astype(np.int32), \
         np.round(w.imag * (1 << 14)).astype(np.int32)
     out = []
@@ -190,6 +191,10 @@ def _dft_twiddles_q14(n: int):
 
 
 _TW64 = _dft_twiddles_q14(64)
+# inverse twiddles with the 802.11 OFDM time scale folded in:
+# time = IDFT_sum(bins) * (TIME_SCALE / 64) = IDFT_sum / sqrt(52)
+_ITW64_WIFI = _dft_twiddles_q14(64, inverse=True,
+                                scale=1.0 / np.sqrt(52.0))
 
 
 def _gemm_q14(x, hi, lo):
@@ -202,6 +207,18 @@ def _gemm_q14(x, hi, lo):
     return dot(x, hi) + rsra(dot(x, lo), 7)
 
 
+def _cdft_q14(pair, key: str, table, shift: int):
+    """The one complex split-Q14 GEMM body shared by the forward and
+    inverse DFTs: four int32 GEMMs + the module's rounding rule."""
+    p = jnp.asarray(pair, I32)
+    xr, xi = p[..., 0], p[..., 1]
+    (rh, rl), (ih, il) = _const(key, lambda: tuple(
+        (jnp.asarray(h), jnp.asarray(l)) for h, l in table))
+    re = _gemm_q14(xr, rh, rl) - _gemm_q14(xi, ih, il)
+    im = _gemm_q14(xr, ih, il) + _gemm_q14(xi, rh, rl)
+    return jnp.stack([rsra(re, shift), rsra(im, shift)], axis=-1)
+
+
 def dft64_q14(pair, shift: int = 7):
     """Integer 64-point DFT of int IQ pairs (..., 64, 2) via four int32
     GEMMs against split Q14 twiddles.
@@ -211,13 +228,17 @@ def dft64_q14(pair, shift: int = 7):
     rounding bits). shift=7 returns the unnormalized DFT at input
     scale: bins = sum_n x[n] w^(nk) exactly (to the documented
     rounding)."""
-    p = jnp.asarray(pair, I32)
-    xr, xi = p[..., 0], p[..., 1]
-    (rh, rl), (ih, il) = _const("tw64", lambda: tuple(
-        (jnp.asarray(h), jnp.asarray(l)) for h, l in _TW64))
-    re = _gemm_q14(xr, rh, rl) - _gemm_q14(xi, ih, il)
-    im = _gemm_q14(xr, ih, il) + _gemm_q14(xi, rh, rl)
-    return jnp.stack([rsra(re, shift), rsra(im, shift)], axis=-1)
+    return _cdft_q14(pair, "tw64", _TW64, shift)
+
+
+def idft64_wifi_q14(pair):
+    """Integer 64-point OFDM symbol synthesis: inverse DFT with the
+    802.11 time scale folded into the twiddles —
+    out = round-ish(IDFT_sum(bins) / sqrt(52)), i.e. integer bins at
+    wire scale S produce time samples at the same wire scale the f32
+    chain's ifft * TIME_SCALE * S produces. Same split-Q14 GEMM
+    machinery (and rounding rule) as the forward dft64_q14."""
+    return _cdft_q14(pair, "itw64", _ITW64_WIFI, 7)
 
 
 # ------------------------------------------------------ pair arithmetic
