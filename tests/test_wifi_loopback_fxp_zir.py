@@ -47,6 +47,20 @@ def test_loopback_fxp_hybrid_matches_interp():
     np.testing.assert_array_equal(gh, want)
 
 
+def test_loopback_fxp_random_rate_length_fuzz():
+    """Randomized rate/length mix through the ALL-INTEGER loopback:
+    every payload must come back exactly (the TX-fuzz discipline of
+    test_wifi_tx_rates_zir applied to the integer chain)."""
+    rng = np.random.default_rng(360)
+    rates = [6, 9, 12, 18, 24, 36, 48, 54]
+    pairs = [(int(rng.choice(rates)), int(rng.integers(10, 60)))
+             for _ in range(5)]
+    xs, want = _frames(pairs, seed=361)
+    prog = compile_file(SRC, fxp_complex16=True)
+    got = np.asarray(run(prog.comp, xs).out_array(), np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
 @pytest.mark.parametrize("rate", [6, 18, 36, 54])
 def test_fxp_tx_air_signal_decodes_under_float_receiver(rate):
     """Cross-family compliance: the integer transmitter's wire signal

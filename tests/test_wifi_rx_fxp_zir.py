@@ -64,6 +64,22 @@ def test_rx_fxp_zir_deterministic_repeat():
     np.testing.assert_array_equal(a, b)
 
 
+def test_rx_fxp_zir_under_framebatch():
+    """The fixed-point receiver is just another hybridized program to
+    the frame batcher: N captures ride batched chunk steps and decode
+    exactly as N sequential runs."""
+    from ziria_tpu.backend.framebatch import StepBatcher, run_many
+    prog = _prog()
+    hyb = H.hybridize(prog.comp)
+    caps = [_capture(m, nb, seed=350 + m)
+            for m, nb in ((6, 30), (24, 60), (54, 90), (24, 45))]
+    got = run_many(hyb, [xs for xs, _w in caps],
+                   batcher=StepBatcher(len(caps)))
+    for (xs, want), g in zip(caps, got):
+        np.testing.assert_array_equal(
+            np.asarray(g.out_array(), np.uint8), want)
+
+
 def test_rx_fxp_zir_fcs_rejects_corruption():
     xs, _ = _capture(24, 60, seed=340)
     xs = [np.asarray(x) for x in xs]
