@@ -2,6 +2,8 @@
 long stream, single-device vs sharded over the 8-device virtual mesh
 with halo exchange — identical results, correct packet starts."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -173,3 +175,45 @@ def test_cli_scan_noise_only(tmp_path):
     assert rc == 0
     got = read_stream(StreamSpec(ty="bit", path=str(outf), mode="bin"))
     assert got.size == 0
+
+
+def test_scan_and_decode_with_fxp_receiver():
+    """The scan's receiver is pluggable, and the FIXED-POINT
+    in-language receiver slots straight in: sp-sharded packet search,
+    then every hit decoded through the all-integer chain with batched
+    chunk steps."""
+    from ziria_tpu.backend.hybrid import hybridize
+    from ziria_tpu.frontend import compile_file
+    from ziria_tpu.phy import channel
+    from ziria_tpu.utils.bits import bytes_to_bits
+
+    rng = np.random.default_rng(4)
+    caps, psdus = [], []
+    for k, (mbps, nb) in enumerate([(24, 50), (54, 70)]):
+        psdu, xi = channel.impaired_capture(
+            mbps, nb, seed=720 + k, cfo=0.001, pre=0, post=0,
+            noise=0.02, add_fcs=True)
+        caps.append(np.asarray(xi))
+        psdus.append(psdu)
+
+    gap = lambda n: np.clip(np.round(rng.normal(
+        scale=20.0, size=(n, 2))), -32768, 32767).astype(np.int16)
+    stream, pos, offsets = [gap(900)], 900, []
+    for xi in caps:
+        offsets.append(pos)
+        stream.append(xi)
+        pos += len(xi)
+        stream.append(gap(900))
+        pos += 900
+    capture = np.concatenate(stream, axis=0)
+
+    src = os.path.join(os.path.dirname(__file__), "..", "examples",
+                       "wifi_rx_fxp.zir")
+    hyb = hybridize(compile_file(src, fxp_complex16=True).comp)
+    got = search.scan_and_decode(capture, mesh=stream_mesh(8),
+                                 comp=hyb)
+    assert len(got) == 2, [g[0] for g in got]
+    for (s, bits), off, psdu in zip(got, offsets, psdus):
+        assert off - 64 <= s <= off + 160, (s, off)
+        np.testing.assert_array_equal(
+            bits, np.asarray(bytes_to_bits(psdu)))
