@@ -80,6 +80,25 @@ def test_rx_fxp_zir_under_framebatch():
             np.asarray(g.out_array(), np.uint8), want)
 
 
+def test_rx_fxp_zir_flag_matrix_ab_exact():
+    """Flag-independence (the suite's metamorphic discipline, SURVEY
+    §4): the fixed-point receiver's hybrid decode is bit-identical
+    with the GF(2) loop compression and the lane vectorizer disabled."""
+    xs, want = _capture(24, 60, seed=345)
+    base = np.asarray(
+        run(H.hybridize(_prog().comp), xs).out_array(), np.uint8)
+    np.testing.assert_array_equal(base, want)
+    for var in ("ZIRIA_NO_GF2_LOOPS", "ZIRIA_NO_VECTOR_LOOPS"):
+        os.environ[var] = "1"
+        try:
+            got = np.asarray(
+                run(H.hybridize(_prog().comp), xs).out_array(),
+                np.uint8)
+        finally:
+            del os.environ[var]
+        np.testing.assert_array_equal(got, base, err_msg=var)
+
+
 def test_rx_fxp_zir_fcs_rejects_corruption():
     xs, _ = _capture(24, 60, seed=340)
     xs = [np.asarray(x) for x in xs]
