@@ -99,6 +99,22 @@ def test_rx_fxp_zir_flag_matrix_ab_exact():
         np.testing.assert_array_equal(got, base, err_msg=var)
 
 
+@pytest.mark.parametrize("scale", [256.0, 8192.0, 24000.0])
+def test_rx_fxp_zir_agc_amplitude_universal(scale):
+    """The in-language power-of-two AGC normalizes ANY int16 capture
+    into the Q schedule's envelope: the same frame decodes at 1/4x,
+    8x, and ~24x the assumed wire amplitude (the integer detector and
+    pilot loop are clamped/rescaled so nothing wraps)."""
+    psdu, cap = channel.impaired_capture(24, 40, seed=555, scale=scale,
+                                         add_fcs=True)
+    got = np.asarray(
+        run(_prog().comp,
+            [p for p in np.asarray(cap, np.int32)]).out_array(),
+        np.uint8)
+    np.testing.assert_array_equal(
+        got, np.asarray(bytes_to_bits(np.asarray(psdu, np.uint8))))
+
+
 def test_rx_fxp_zir_fcs_rejects_corruption():
     xs, _ = _capture(24, 60, seed=340)
     xs = [np.asarray(x) for x in xs]

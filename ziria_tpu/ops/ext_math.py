@@ -107,13 +107,13 @@ def atan2_int16(y, x):
     Pure-integer CORDIC vectoring (ops/fxp.cordic_atan2), so the result
     is bit-identical on every backend — an f32 arctan2 differs by ulps
     between CPU and TPU, which can flip the quantized angle by one
-    step. Inputs are pre-scaled by 2^10 (angle-invariant) so shift
-    truncation stays below the Q15 step even for short vectors; error
-    vs exact atan2 is < ~1e-3 rad over int16 magnitudes >= ~30."""
+    step. Inputs are pre-scaled by 2^12 (angle-invariant; full int16
+    inputs stay inside the vectoring bound) so shift truncation stays
+    below a couple of Q15 steps even for unit-magnitude vectors."""
     jnp = _jnp()
     from ziria_tpu.ops import fxp
-    ang, _mag = fxp.cordic_atan2(jnp.asarray(y, jnp.int32) << 10,
-                                 jnp.asarray(x, jnp.int32) << 10)
+    ang, _mag = fxp.cordic_atan2(jnp.asarray(y, jnp.int32) << 12,
+                                 jnp.asarray(x, jnp.int32) << 12)
     return ang.astype(jnp.int16)
 
 

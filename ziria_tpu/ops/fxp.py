@@ -97,10 +97,11 @@ def quantize_q(x, frac_bits: int):
 def cordic_atan2(y, x):
     """Pure-integer CORDIC vectoring: Q15 turn angle of (y, x).
 
-    Inputs int32 with |x|,|y| <= 2^26 (growth x1.647 must stay inside
-    int32). Returns (angle_q15 int32 in [-32768, 32767],
+    Inputs int32 with |x|,|y| <= 2^28 (the x1.6467*sqrt(2) growth must
+    stay inside int32). Returns (angle_q15 int32 in [-32768, 32767],
     magnitude int32 ~= 1.6467 * sqrt(x^2 + y^2)).
-    Angle error <= ~2 Q15 steps; exactly reproducible everywhere.
+    Angle error <= ~2 Q15 steps at large magnitudes; exactly
+    reproducible everywhere.
     """
     x = jnp.asarray(x, I32)
     y = jnp.asarray(y, I32)
@@ -175,9 +176,11 @@ def cordic_rotate(pair, angle_q15, kinv_bits: int = 15):
 def _dft_twiddles_q14(n: int, inverse: bool = False,
                       scale: float = 1.0):
     """DFT matrix exp(-+2*pi*i*j*k/n) * scale in Q14, split into
-    (hi, lo) int factors with W == hi * 128 + lo, each factor in int8
-    range — the two-GEMM trick that keeps a 64-term int32 accumulation
-    inside int32 (64 * 2^15 * 2^14 would need 36 bits unsplit)."""
+    (hi, lo) int factors with W == hi * 128 + lo, |hi| <= 128 and
+    lo in [0, 127] (NOTE: hi reaches +128 for the unit twiddle — the
+    factors are 8-bit-magnitude, not storable as int8) — the two-GEMM
+    trick that keeps a 64-term int32 accumulation inside int32
+    (64 * 2^15 * 2^14 would need 36 bits unsplit)."""
     jk = np.outer(np.arange(n), np.arange(n))
     w = np.exp((2j if inverse else -2j) * np.pi * jk / n) * scale
     wq = np.round(w.real * (1 << 14)).astype(np.int32), \

@@ -49,10 +49,11 @@ _W_SHIFT = 3           # working shift down to demap precision
 # z products <= 2^27, zw <= 2^20.5, zw * NORM_Q7 <= 2^30.2 — all int32
 LLR_SHIFT = 5          # int32 LLR -> int16 output scale
 
-# level-domain norm constants (demap.py _NORM) in Q7
-_NORM_Q7 = {1: 1 << 7, 2: int(round(np.sqrt(2.0) * 128)),
-            4: int(round(np.sqrt(10.0) * 128)),
-            6: int(round(np.sqrt(42.0) * 128))}
+# level-domain norm constants in Q7 — DERIVED from the float
+# demapper's table so the two can never drift (the whole fxp demap
+# contract is "algebraically the same LLRs as demap.py")
+from ziria_tpu.ops.demap import _NORM as _NORM_F
+_NORM_Q7 = {k: int(round(v * 128)) for k, v in _NORM_F.items()}
 
 
 def quantize_frame(frame_f32):
