@@ -228,3 +228,34 @@ def test_wifi_rx_zir_lfsr_loops_engage():
     assert sum(hits) >= 2, hits   # descramble + FCS register
     want = np.asarray(bytes_to_bits(psdu))
     np.testing.assert_array_equal(np.asarray(out, np.uint8), want)
+
+
+def test_wifi_rx_fxp_zir_lfsr_loops_engage():
+    # the FIXED-POINT receiver's bit loops (descramble + FCS register)
+    # compress the same way — the integer program gets the same
+    # compiled-loop treatment as the float flagship
+    from ziria_tpu.backend import hybrid as HY
+    from ziria_tpu.frontend import compile_file
+    from ziria_tpu.phy import channel
+    from ziria_tpu.utils.bits import bytes_to_bits
+
+    srcf = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "wifi_rx_fxp.zir")
+    psdu, xi = channel.impaired_capture(24, 60, seed=6, add_fcs=True)
+    hits = []
+    orig = G.gf2_for
+
+    def spy(*a):
+        r = orig(*a)
+        hits.append(r)
+        return r
+
+    G.gf2_for = spy
+    try:
+        hyb = HY.hybridize(compile_file(srcf, fxp_complex16=True).comp)
+        out = run(hyb, [p for p in xi]).out_array()
+    finally:
+        G.gf2_for = orig
+    assert sum(hits) >= 2, hits   # descramble + FCS register
+    want = np.asarray(bytes_to_bits(psdu))
+    np.testing.assert_array_equal(np.asarray(out, np.uint8), want)
