@@ -27,7 +27,9 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
     backend/   JAX lowering: fused jit step functions, vectorization planner
     ops/       DSP primitive library (FFT, FIR, Viterbi incl. Pallas kernel,
                bit/CRC/scrambler/coding utilities)
-    phy/       802.11a/g PHY: TX chain, RX chain, channel models, loopback
+    phy/       802.11a/g PHY: TX chain, RX chain (f32 + Q15 integer
+               interior via rx.receive(fxp=True)), channel models,
+               loopback
     parallel/  mesh construction, frame-batch sharding, stage sharding
     runtime/   host driver loop, typed stream file I/O, params/CLI
     utils/     dtype policy, tolerance differ (BlinkDiff equivalent), bits
