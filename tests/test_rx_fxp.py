@@ -70,6 +70,28 @@ def test_cordic_rotate_accuracy(kinv_bits, scale, tol_rel):
             ).all()
 
 
+def test_idft64_wifi_matches_ifft_timescale():
+    """The inverse brick folds TIME_SCALE/64 = 1/sqrt(52) into its
+    twiddles: integer bins at scale S -> time samples matching
+    ifft * 64/sqrt(52) * S."""
+    rng = np.random.default_rng(13)
+    bins = (rng.normal(size=(4, 64, 2)) * 500).astype(np.int32)
+    got = np.asarray(fxp.idft64_wifi_q14(jnp.asarray(bins)), np.float64)
+    bc = bins[..., 0] + 1j * bins[..., 1]
+    want = np.fft.ifft(bc, axis=-1) * 64.0 / np.sqrt(52.0)
+    err = np.abs((got[..., 0] + 1j * got[..., 1]) - want)
+    assert err.max() <= 2 + 2e-4 * np.abs(want).max()
+
+
+def test_quantize_q_nonfinite_and_saturation():
+    x = np.array([np.nan, np.inf, -np.inf, 100.0, -100.0, 0.4999e-3],
+                 np.float32)
+    got = np.asarray(fxp.quantize_q(x, 11))
+    assert got[0] == 0 and got[1] == 32767 and got[2] == -32768
+    assert got[3] == 32767 and got[4] == -32768   # saturated
+    assert got[5] == 1                            # round-half-up
+
+
 def test_dft64_matches_fft():
     rng = np.random.default_rng(3)
     x = (rng.normal(size=(5, 64, 2)) * 8000).astype(np.int32)
