@@ -137,3 +137,15 @@ def test_run_link_step_exhaustion_fails_cleanly():
     run_link(a, b, [b"lost", b"also-lost"], channel=dead, max_steps=3)
     assert a.failed == [0, 1]
     assert a.counters["drops"] == 2
+
+
+def test_perfect_link_fxp_stations():
+    # both stations receive through the Q15 integer interior — the
+    # MAC loop on the reference's fixed-point discipline
+    a = Station(addr=1, rate_mbps=24, fxp=True)
+    b = Station(addr=2, fxp=True)
+    payloads = [b"integer frame one", b"and two"]
+    run_link(a, b, payloads)
+    assert [p for _, p in b.delivered] == payloads
+    assert a.acked == [0, 1] and a.failed == []
+    assert a.counters["retries"] == 0

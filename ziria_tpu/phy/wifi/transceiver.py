@@ -84,11 +84,16 @@ class _Pending:
 
 @dataclass
 class Station:
-    """Half-duplex stop-and-wait station."""
+    """Half-duplex stop-and-wait station.
+
+    fxp=True receives through the Q15 integer interior
+    (rx.receive(fxp=True) — phy/wifi/rx_fxp.py): the MAC loop on the
+    reference's fixed-point discipline."""
 
     addr: int
     rate_mbps: int = 24
     max_tries: int = 4
+    fxp: bool = False
     now: int = 0                      # local clock, in samples
     delivered: List[Tuple[int, bytes]] = field(default_factory=list)
     acked: List[int] = field(default_factory=list)
@@ -143,7 +148,7 @@ class Station:
         """Process received samples; returns response samples (an ACK
         after a SIFS of silence) or None."""
         self.now += int(np.asarray(samples).shape[0])
-        res = rx.receive(samples, check_fcs=False)
+        res = rx.receive(samples, check_fcs=False, fxp=self.fxp)
         if not res.ok:
             return None
         psdu_bytes = np_bits_to_bytes(np.asarray(res.psdu_bits, np.uint8))
