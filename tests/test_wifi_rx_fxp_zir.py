@@ -99,12 +99,13 @@ def test_rx_fxp_zir_flag_matrix_ab_exact():
         np.testing.assert_array_equal(got, base, err_msg=var)
 
 
-@pytest.mark.parametrize("scale", [256.0, 8192.0, 24000.0])
+@pytest.mark.parametrize("scale", [256.0, 8192.0, 24000.0, 30000.0])
 def test_rx_fxp_zir_agc_amplitude_universal(scale):
     """The in-language power-of-two AGC normalizes ANY int16 capture
-    into the Q schedule's envelope: the same frame decodes at 1/4x,
-    8x, and ~24x the assumed wire amplitude (the integer detector and
-    pilot loop are clamped/rescaled so nothing wraps)."""
+    into the Q schedule's envelope: the same frame decodes from 1/4x
+    to rail-clipping amplitudes (at scale 30000 hundreds of samples
+    saturate — the detector's pre-shifted products cannot wrap even
+    at +-32768)."""
     psdu, cap = channel.impaired_capture(24, 40, seed=555, scale=scale,
                                          add_fcs=True)
     got = np.asarray(

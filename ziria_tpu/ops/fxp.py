@@ -88,8 +88,8 @@ def sat16(x):
 def quantize_q(x, frac_bits: int):
     """Float -> int32 Q(frac_bits) with round-half-up + int16
     saturation. The fixed-point boundary for float-domain captures.
-    Non-finite samples quantize to 0 (a float->int astype of NaN/inf
-    is implementation-defined; a dead sample is the honest value)."""
+    NaN quantizes to 0 and +-inf saturates to the rails (a float->int
+    astype of non-finite values is implementation-defined)."""
     x = jnp.nan_to_num(jnp.asarray(x, jnp.float32),
                        nan=0.0, posinf=32767.0, neginf=-32768.0)
     return sat16(jnp.floor(x * (1 << frac_bits) + 0.5).astype(I32))
