@@ -44,6 +44,16 @@ import numpy as np
 REPO = os.path.dirname(os.path.abspath(__file__))
 PARTIAL_PATH = os.path.join(REPO, "BENCH_PARTIAL.jsonl")
 LIVE_PATH = os.path.join(REPO, "BENCH_LIVE.json")
+BASELINE_PATH = os.path.join(REPO, "BASELINE.json")
+
+# Stage-record schema version: bump whenever a stage's semantics change
+# so resume (below) can never reuse a measurement whose meaning moved.
+BENCH_STAGE_VERSION = 5
+# A completed stage this recent (and this code version, same platform)
+# is reused instead of re-measured: the axon window flaps, and r4 lost
+# two windows re-burning already-captured stages from zero (VERDICT r4
+# missing #1). 6 h spans watcher-harvest -> driver-run within a round.
+RESUME_WINDOW_DEFAULT = 21600.0
 
 PROBE_TIMEOUT = 90
 PROBE_TRIES = 2
@@ -228,9 +238,67 @@ def _partial(run_id, stage, **kv):
     """Append one completed stage to BENCH_PARTIAL.jsonl (crash-proof
     evidence: the parent recovers the headline number from here if the
     child is later killed by a timeout)."""
-    rec = {"run_id": run_id, "stage": stage, "t": time.time(), **kv}
+    rec = {"run_id": run_id, "stage": stage, "t": time.time(),
+           "ver": BENCH_STAGE_VERSION, **kv}
     with open(PARTIAL_PATH, "a") as f:
         f.write(json.dumps(rec) + "\n")
+
+
+def _load_resume(platform, window_s, now=None, path=PARTIAL_PATH,
+                 workload_bytes=1000):
+    """Most recent completed stage records eligible for reuse.
+
+    Eligible = same schema version, same platform, younger than the
+    resume window, and not an error record. Batch-sweep probes are
+    keyed per width so each width resumes independently. This is what
+    makes the child *stage-resumable*: a flapping 480 s window
+    accumulates stages across invocations instead of re-burning the
+    ones already measured (VERDICT r4 missing #1 / next #1).
+    """
+    now = time.time() if now is None else now
+    out = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                # the window is gated on the ORIGINAL capture time:
+                # a resumed re-emission carries captured_t forward so
+                # chained resumes cannot keep a measurement alive past
+                # the window it was actually taken in
+                t_cap = rec.get("captured_t", rec.get("t", 0))
+                if (rec.get("ver") != BENCH_STAGE_VERSION
+                        or rec.get("platform") != platform
+                        or rec.get("workload_bytes") != workload_bytes
+                        or t_cap < now - window_s
+                        or rec.get("error")):
+                    continue
+                keys = [rec.get("stage")]
+                if keys[0] == "batch_sweep":
+                    keys = [f"batch_sweep:{rec.get('batch')}"]
+                elif keys[0] == "headline":
+                    # a run emits headline at B=128 and again when the
+                    # sweep promotes a wider B — keep each width's
+                    # measurement as well as the latest promotion
+                    keys.append(f"headline:{rec.get('batch')}")
+                for key in keys:
+                    if key not in out or rec["t"] > out[key]["t"]:
+                        out[key] = rec
+    except OSError:
+        pass
+    return out
+
+
+_RESUME_META = ("run_id", "stage", "t", "ver", "resumed_from",
+                "captured_t", "platform", "workload_bytes")
+
+
+def _stage_payload(rec):
+    """A resumed record's measurement fields, minus bookkeeping
+    (platform is re-stamped by the emitting child, not carried)."""
+    return {k: v for k, v in rec.items() if k not in _RESUME_META}
 
 
 def _probe_main():
@@ -298,15 +366,61 @@ def _child_main(run_id):
     rate, n_sym, n_psdu_bits, frame_len, frame, want = _setup()
     note("frame encoded")
 
-    # batched correctness gate (also the single-frame gate: row 0)
+    def part(stage, **kv):
+        kv.setdefault("platform", dev.platform)
+        kv.setdefault("workload_bytes", n_psdu_bits // 8)
+        _partial(run_id, stage, **kv)
+
+    # stage resume: reuse measurements a recent same-version,
+    # same-platform, same-workload child already recorded, re-emitting
+    # them under THIS run_id (tagged resumed_from) so partial recovery
+    # and the ledger both see what this run published
+    resume = {}
+    if os.environ.get("ZIRIA_BENCH_RESUME", "1") != "0":
+        window = float(os.environ.get("BENCH_RESUME_WINDOW",
+                                      str(RESUME_WINDOW_DEFAULT)))
+        resume = _load_resume(dev.platform, window,
+                              workload_bytes=n_psdu_bits // 8)
+        resume.pop("backend_up", None)   # always re-proven above
+        resume.pop("complete", None)     # always re-merged below
+        if resume:
+            note(f"resume: reusable stages {sorted(resume)}")
+    resumed_stages = []
+
+    def reuse(rec):
+        resumed_stages.append(rec["stage"])
+        part(rec["stage"], **_stage_payload(rec),
+             resumed_from=rec.get("resumed_from", rec["run_id"]),
+             captured_t=rec.get("captured_t", rec["t"]))
+        return _stage_payload(rec)
+
+    # seed the batch-width table from resumable measurements: the
+    # headline record carries the B it was promoted at, sweep probes
+    # carry theirs — each width already measured is not re-burned
+    sweep = {}
+    width_cap = {}   # batch -> original capture time (resume provenance)
+    for key, rec in resume.items():
+        if (key.startswith("headline:") or key.startswith("batch_sweep:")) \
+                and "t_step_s" in rec and "batch" in rec:
+            sweep.setdefault(rec["batch"], rec["t_step_s"])
+            width_cap.setdefault(rec["batch"],
+                                 rec.get("captured_t", rec["t"]))
+    fresh_widths = set()   # widths actually measured by THIS child
+
     B = 128
     frames = jnp.asarray(np.broadcast_to(frame, (B,) + frame.shape).copy())
     decode = jax.jit(
         lambda f: rx.decode_data_batch(f, rate, n_sym, n_psdu_bits)[0])
-    got_b = np.asarray(decode(frames))
-    assert np.array_equal(got_b[0], want) and np.array_equal(got_b[-1], want)
-    note("batched correctness gate passed; timing")
-    _partial(run_id, "correctness", batch=B)
+    if B in sweep and "correctness" in resume:
+        reuse(resume["correctness"])
+        note("correctness + B=128 timing resumed from prior window")
+    else:
+        # batched correctness gate (also the single-frame gate: row 0)
+        got_b = np.asarray(decode(frames))
+        assert np.array_equal(got_b[0], want) \
+            and np.array_equal(got_b[-1], want)
+        note("batched correctness gate passed; timing")
+        part("correctness", batch=B)
 
     # Steady-state throughput, amortized ON DEVICE. Measured r2: the
     # axon tunnel costs ~70 ms per host round-trip and ~2-4 ms per
@@ -372,25 +486,42 @@ def _child_main(run_id):
 
     def emit_headline(stage, b, t, method):
         """One definition of a measured-throughput partial record, so
-        the headline, sweep probes, and promotion can't drift apart."""
-        _partial(run_id, stage, tpu_sps=b * frame_len / t, t_step_s=t,
-                 batch=b, platform=dev.platform,
-                 device_kind=getattr(dev, "device_kind", "?"),
-                 timing_method=method,
-                 roofline=_roofline(b, frame_len, n_sym, n_psdu_bits, t))
+        the headline, sweep probes, and promotion can't drift apart.
+        A record whose width was NOT measured by this child carries the
+        original capture time so chained resumes age out honestly."""
+        extra = {}
+        if b not in fresh_widths and b in width_cap:
+            extra["captured_t"] = width_cap[b]
+        part(stage, tpu_sps=b * frame_len / t, t_step_s=t, batch=b,
+             device_kind=getattr(dev, "device_kind", "?"),
+             timing_method=method,
+             roofline=_roofline(b, frame_len, n_sym, n_psdu_bits, t),
+             **extra)
 
     K1, K2 = 32, 160
-    t1, t2 = timed_k(decode_k, frames, K1), timed_k(decode_k, frames, K2)
-    t_tpu = (t2 - t1) / (K2 - K1)
+    if f"headline:{B}" in resume:
+        # resumed: the base-width step was measured by a recent child
+        # on this platform (checksum-gated before it was recorded)
+        hl = reuse(resume[f"headline:{B}"])
+        t_tpu = hl["t_step_s"]
+        sweep[B] = t_tpu
+        timing_method = (f"marginal device-loop step (K={K1} vs {K2}), "
+                         f"resumed from prior window")
+        note(f"device-loop: B={B} step {t_tpu*1e3:.3f} ms (resumed)")
+    else:
+        t1, t2 = timed_k(decode_k, frames, K1), timed_k(decode_k, frames, K2)
+        t_tpu = (t2 - t1) / (K2 - K1)
+        timing_method = f"marginal device-loop step (K={K1} vs {K2})"
+        note(f"device-loop: K={K1}: {t1*1e3:.1f} ms, K={K2}: {t2*1e3:.1f} ms"
+             f" -> marginal {t_tpu*1e3:.3f} ms/step")
+        # verify the loop body's decode BEFORE the record exists: a
+        # failed checksum must leave nothing for partial recovery
+        a128 = int(decode_k(frames, jnp.int32(2)))
+        assert a128 == _chk_expected(B, 2), (a128, _chk_expected(B, 2))
+        fresh_widths.add(B)
+        emit_headline("headline", B, t_tpu, timing_method)
+        sweep[B] = t_tpu
     sps = B * frame_len / t_tpu
-    timing_method = f"marginal device-loop step (K={K1} vs {K2})"
-    note(f"device-loop: K={K1}: {t1*1e3:.1f} ms, K={K2}: {t2*1e3:.1f} ms"
-         f" -> marginal {t_tpu*1e3:.3f} ms/step")
-    # verify the loop body's decode BEFORE the record exists: a failed
-    # checksum must leave nothing for partial recovery to publish
-    a128 = int(decode_k(frames, jnp.int32(2)))
-    assert a128 == _chk_expected(B, 2), (a128, _chk_expected(B, 2))
-    emit_headline("headline", B, t_tpu, timing_method)
 
     # Pallas-on-Mosaic proof: decode with interpret=False explicitly and
     # compare to the lax.scan oracle. On a real TPU this compiles the
@@ -398,20 +529,25 @@ def _child_main(run_id):
     # Ordered BEFORE the batch sweep: this is load-bearing round
     # evidence and must land even if the sweep eats the remaining
     # child budget.
-    from ziria_tpu.ops import viterbi, viterbi_pallas
-    rng = np.random.default_rng(1)
-    llrs = jnp.asarray(rng.normal(size=(4, 1024, 2)).astype(np.float32))
-    # interpret=False means Mosaic — except in the CPU smoke mode,
-    # where Pallas has no backend and interpret mode stands in
-    hard = viterbi_pallas.viterbi_decode_batch(
-        llrs, interpret=(dev.platform == "cpu"))
-    oracle = jax.vmap(viterbi.viterbi_decode)(llrs)
-    assert np.array_equal(np.asarray(hard), np.asarray(oracle)), \
-        "Pallas (Mosaic) Viterbi != lax.scan oracle"
-    pallas_mosaic = dev.platform != "cpu"
-    note("Pallas kernels compiled by Mosaic, match oracle"
-         if pallas_mosaic else "Pallas kernels in interpret mode (smoke)")
-    _partial(run_id, "pallas_mosaic", pallas_mosaic=pallas_mosaic)
+    if "pallas_mosaic" in resume:
+        pallas_mosaic = bool(resume["pallas_mosaic"].get("pallas_mosaic"))
+        reuse(resume["pallas_mosaic"])
+        note("Pallas-Mosaic proof resumed from prior window")
+    else:
+        from ziria_tpu.ops import viterbi, viterbi_pallas
+        rng = np.random.default_rng(1)
+        llrs = jnp.asarray(rng.normal(size=(4, 1024, 2)).astype(np.float32))
+        # interpret=False means Mosaic — except in the CPU smoke mode,
+        # where Pallas has no backend and interpret mode stands in
+        hard = viterbi_pallas.viterbi_decode_batch(
+            llrs, interpret=(dev.platform == "cpu"))
+        oracle = jax.vmap(viterbi.viterbi_decode)(llrs)
+        assert np.array_equal(np.asarray(hard), np.asarray(oracle)), \
+            "Pallas (Mosaic) Viterbi != lax.scan oracle"
+        pallas_mosaic = dev.platform != "cpu"
+        note("Pallas kernels compiled by Mosaic, match oracle"
+             if pallas_mosaic else "Pallas kernels in interpret mode (smoke)")
+        part("pallas_mosaic", pallas_mosaic=pallas_mosaic)
 
     # Batch-width sweep: the B=128 headline leaves the chip ~96% idle
     # (roofline above) — the decode is dependency-chain-bound, so wider
@@ -420,11 +556,16 @@ def _child_main(run_id):
     # to the headline. Each width is one fresh compile of decode_k;
     # its result is recorded as a partial before the next compile
     # starts, so a flapping tunnel keeps whatever was measured.
-    # ZIRIA_BENCH_SWEEP=0 pins the headline at B=128.
-    sweep = {B: t_tpu}
+    # ZIRIA_BENCH_SWEEP=0 pins the headline at B=128. Widths already
+    # seeded from a resumed window are skipped, so re-entry spends the
+    # budget on the widths still missing (B=1024 never ran in r4).
     if os.environ.get("ZIRIA_BENCH_SWEEP", "1") != "0":
         Ks1, Ks2 = 8, 40
-        for Bs in (256, 512):
+        for Bs in (256, 512, 1024):
+            if Bs in sweep:
+                note(f"sweep: B={Bs} resumed "
+                     f"({sweep[Bs]*1e3:.3f} ms/step)")
+                continue
             # guard on the REAL kill budget the parent runs us under
             # (review: a constant above the parent's hard timeout can
             # never fire and every harvest died mid-aux as a partial)
@@ -452,6 +593,7 @@ def _child_main(run_id):
                          f"implausible (< B=128's {t_tpu*1e3:.3f} ms)"
                          f" — discarded")
                     continue
+                fresh_widths.add(Bs)
                 sweep[Bs] = t_b
                 note(f"sweep: B={Bs} marginal {t_b*1e3:.3f} ms/step"
                      f" ({Bs * frame_len / t_b / 1e6:.0f} M sps)")
@@ -469,6 +611,12 @@ def _child_main(run_id):
             timing_method = (f"marginal device-loop step (K={Ks1} vs "
                              f"{Ks2}), best of batch sweep "
                              f"{sorted(sweep)}")
+            if B_best not in fresh_widths:
+                # the winning width's measurement came from a prior
+                # window — the published result must say so, not just
+                # the buried partial record (review finding)
+                timing_method += ", width resumed from prior window"
+                resumed_stages.append("headline")
             note(f"sweep: promoting B={B} to headline"
                  f" ({sps/1e6:.0f} M sps)")
             emit_headline("headline", B, t_tpu, timing_method)
@@ -478,7 +626,7 @@ def _child_main(run_id):
     # receiver should ride ~the single-frame device-call count. Timed
     # here because the win is exactly the per-call tunnel cost the
     # marginal-step methodology above factors out.
-    try:
+    def _framebatch_stage():
         if time.time() - t0 > 0.75 * budget:
             raise TimeoutError("skipped: child time budget")
         from ziria_tpu.backend import chunked as CH
@@ -513,18 +661,25 @@ def _child_main(run_id):
               "t_batched_s": round(t_bat, 3)}
         note(f"framebatch: {calls_seq} calls / {t_seq:.2f}s sequential"
              f" -> {b2.device_calls} calls / {t_bat:.2f}s batched")
-        _partial(run_id, "framebatch", **fb)
-    except Exception as e:            # evidence stage: never fatal
-        note(f"framebatch stage failed: {e!r}")
-        fb = {"error": repr(e)}
+        part("framebatch", **fb)
+        return fb
+
+    if "framebatch" in resume:
+        fb = reuse(resume["framebatch"])
+        note("framebatch resumed from prior window")
+    else:
+        try:
+            fb = _framebatch_stage()
+        except Exception as e:        # evidence stage: never fatal
+            note(f"framebatch stage failed: {e!r}")
+            fb = {"error": repr(e)}
 
     # Fixed-point interior on-chip (r4 session 3): the Q15 integer
     # decode (phy/wifi/rx_fxp.py) timed with the same marginal-step
     # methodology at B=128 — evidence of what the reference's int16
     # discipline costs/earns on the VPU vs the f32 fast path.
     # Non-fatal, budget-guarded.
-    fxp_ev = None
-    try:
+    def _fxp_stage():
         if time.time() - t0 > 0.85 * budget:
             raise TimeoutError("skipped: child time budget")
         from ziria_tpu.phy.wifi import rx_fxp
@@ -555,48 +710,69 @@ def _child_main(run_id):
         note(f"fxp interior: {t_fxp*1e3:.3f} ms/step "
              f"({fxp_ev['sps']/1e6:.0f} M sps, "
              f"{fxp_ev['vs_f32_interior']:.2f}x the f32 step)")
-        _partial(run_id, "fxp_interior", **fxp_ev)
-    except Exception as e:              # evidence stage: never fatal
-        note(f"fxp stage failed: {e!r}")
-        fxp_ev = {"error": repr(e)}
+        part("fxp_interior", **fxp_ev)
+        return fxp_ev
 
-    # per-call diagnostic (tunnel-dispatch-bound upper bound on
-    # latency) — always taken at the base batch of 128, which may
-    # differ from the promoted headline batch; recorded as such
-    t_percall = _time(decode, frames, reps=50)
-    note(f"t_marginal={t_tpu*1e3:.3f} ms t_percall={t_percall*1e3:.3f} ms")
+    if "fxp_interior" in resume:
+        fxp_ev = reuse(resume["fxp_interior"])
+        note("fxp interior resumed from prior window")
+    else:
+        try:
+            fxp_ev = _fxp_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"fxp stage failed: {e!r}")
+            fxp_ev = {"error": repr(e)}
 
-    # fence audit (VERDICT r1 weak #8): block_until_ready has been
-    # observed to return before the device drains through the axon
-    # tunnel. Time a chained 2k matmul with both fences; a bur/copy
-    # ratio well below 1 proves the copy fence is load-bearing, ~1
-    # means bur is currently honest. Recorded every run so the
-    # workaround is evidence, not folklore.
-    a = jnp.asarray(np.random.default_rng(3).normal(
-        size=(2048, 2048)).astype(np.float32))
-    mm = jax.jit(lambda x: x @ x * 1e-3)
+    def _percall_fence_stage():
+        # per-call diagnostic (tunnel-dispatch-bound upper bound on
+        # latency) — always taken at the base batch of 128, which may
+        # differ from the promoted headline batch; recorded as such
+        t_percall = _time(decode, frames, reps=50)
+        note(f"t_marginal={t_tpu*1e3:.3f} ms "
+             f"t_percall={t_percall*1e3:.3f} ms")
 
-    def chain(fence_fn, reps=10):
-        o = mm(a)
-        fence_fn(o)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            o = mm(o)
-        fence_fn(o)
-        return (time.perf_counter() - t0) / reps
+        # fence audit (VERDICT r1 weak #8): block_until_ready has been
+        # observed to return before the device drains through the axon
+        # tunnel. Time a chained 2k matmul with both fences; a bur/copy
+        # ratio well below 1 proves the copy fence is load-bearing, ~1
+        # means bur is currently honest. Recorded every run so the
+        # workaround is evidence, not folklore.
+        a = jnp.asarray(np.random.default_rng(3).normal(
+            size=(2048, 2048)).astype(np.float32))
+        mm = jax.jit(lambda x: x @ x * 1e-3)
 
-    t_copy = chain(_block)
-    t_bur = chain(jax.block_until_ready)
-    fence_audit = round(t_bur / t_copy, 3)
-    note(f"fence audit: bur/copy = {fence_audit} "
-         f"({'bur returns early — copy fence required' if fence_audit < 0.8 else 'bur honest here'})")
+        def chain(fence_fn, reps=10):
+            o = mm(a)
+            fence_fn(o)
+            ts = time.perf_counter()
+            for _ in range(reps):
+                o = mm(o)
+            fence_fn(o)
+            return (time.perf_counter() - ts) / reps
+
+        t_copy = chain(_block)
+        t_bur = chain(jax.block_until_ready)
+        fence_audit = round(t_bur / t_copy, 3)
+        note(f"fence audit: bur/copy = {fence_audit} "
+             f"({'bur returns early — copy fence required' if fence_audit < 0.8 else 'bur honest here'})")
+        pf = {"t_percall_s": t_percall, "t_percall_batch": 128,
+              "fence_audit_bur_over_copy": fence_audit}
+        part("percall_fence", **pf)
+        return pf
+
+    if "percall_fence" in resume:
+        pf = reuse(resume["percall_fence"])
+        note("per-call + fence audit resumed from prior window")
+    else:
+        try:
+            pf = _percall_fence_stage()
+        except Exception as e:          # diagnostic: never fatal
+            note(f"percall/fence stage failed: {e!r}")
+            pf = {"error": repr(e)}
 
     out = {
         "tpu_sps": sps,
         "t_step_s": t_tpu,
-        "t_percall_s": t_percall,
-        "t_percall_batch": 128,
-        "fence_audit_bur_over_copy": fence_audit,
         "timing_method": timing_method,
         "batch": B,
         "frame_bytes": n_psdu_bits // 8,
@@ -607,7 +783,12 @@ def _child_main(run_id):
         "framebatch": fb,
         "fxp_interior": fxp_ev,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
+        "resumed_stages": sorted(set(resumed_stages)),
     }
+    for k in ("t_percall_s", "t_percall_batch",
+              "fence_audit_bur_over_copy"):
+        if k in pf:
+            out[k] = pf[k]
     _partial(run_id, "complete", **out)
     print(json.dumps(out), flush=True)
 
@@ -737,13 +918,132 @@ def _release_tpu():
         pass
 
 
+def _pinned_baseline():
+    """The committed, load-isolated baseline denominator (VERDICT r4
+    missing #2): BASELINE.json's ``pinned_baseline`` entry, written by
+    ``bench.py --pin-baseline`` on an idle box. Every published chip
+    multiple divides by THIS number so the flagship claim cannot float
+    with whatever else the host happens to be running."""
+    try:
+        with open(BASELINE_PATH) as f:
+            pin = json.load(f).get("pinned_baseline")
+        if pin and pin.get("sps"):
+            return pin
+    except (OSError, json.JSONDecodeError):
+        pass
+    return None
+
+
+def _pin_baseline_main(n_runs):
+    """Measure the numpy+C-AVX2 baseline N times and pin the max.
+
+    The denominator must not swing with host load (r4 saw 4.08-6.40 M
+    sps for the same code depending on what else was running), and it
+    must be the number most favorable to the BASELINE: concurrent load
+    can only slow the baseline down, so the fastest of N runs is the
+    closest observation of the uncontended machine — and dividing by
+    it yields the SMALLEST (most conservative) chip multiple. The max,
+    the median, and every raw run are committed so the spread is
+    inspectable.
+    """
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rate, n_sym, n_psdu_bits, frame_len, frame, want = _setup()
+    got = np_rx_decode(frame, rate, n_sym, n_psdu_bits)
+    assert np.array_equal(got, want), "baseline decode mismatch"
+
+    sps_runs, vit_runs = [], []
+    from ziria_tpu.runtime.native_lib import load, viterbi_decode_native
+    have_native = load() is not None
+    nb = n_psdu_bits + 16 + 6
+    dep = np.random.default_rng(2).normal(size=(nb, 2)).astype(np.float32)
+    for i in range(n_runs):
+        t_np = _time(np_rx_decode, frame, rate, n_sym, n_psdu_bits,
+                     reps=3, fence=lambda o: None)
+        sps_runs.append(frame_len / t_np)
+        if have_native:
+            t_v = _time(viterbi_decode_native, dep, reps=5,
+                        fence=lambda o: None)
+            vit_runs.append(nb / t_v / 1e6)
+        print(f"[pin-baseline] run {i + 1}/{n_runs}: "
+              f"{sps_runs[-1] / 1e6:.2f} M sps"
+              + (f", viterbi {vit_runs[-1]:.1f} Mb/s"
+                 if vit_runs else ""), file=sys.stderr, flush=True)
+        time.sleep(1)
+
+    # fold in the committed historical observations of the same recipe
+    # on this box (the driver's round-close runs happen on a quieter
+    # machine than a mid-session pin can arrange): the pinned value is
+    # the max over EVERY dated observation, i.e. the least-contended
+    # baseline anyone has recorded — the hardest denominator to beat.
+    import glob
+    hist = {}
+    for p in sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json"))):
+        try:
+            with open(p) as f:
+                j = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        j = (j.get("parsed") or j) if isinstance(j, dict) else {}
+        for node in (j, j.get("last_good") or {}):
+            v = node.get("numpy_baseline_sps")
+            if v:
+                hist[os.path.basename(p)] = max(
+                    hist.get(os.path.basename(p), 0.0), float(v))
+
+    pin = {
+        "sps": round(max(sps_runs + list(hist.values())), 1),
+        "sps_max_this_pin": round(max(sps_runs), 1),
+        "sps_historical": {k: round(v, 1) for k, v in hist.items()},
+        "sps_median": round(float(np.median(sps_runs)), 1),
+        "sps_runs": [round(s, 1) for s in sps_runs],
+        "viterbi_c_simd_mbps": (round(max(vit_runs), 2)
+                                if vit_runs else None),
+        "n_runs": n_runs,
+        "pinned_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "recipe": ("python bench.py --pin-baseline: numpy RX chain + C "
+                   "AVX2 Viterbi, 1000-byte 54 Mbps frame, N runs of "
+                   "_time(reps=3); pinned value = MAX over these runs "
+                   "AND every committed BENCH_r0*.json observation of "
+                   "the same recipe (the least-contended observation — "
+                   "concurrent load only slows the baseline, so max is "
+                   "the conservative denominator yielding the smallest "
+                   "chip multiple)"),
+        "spread_pct": round(100 * (max(sps_runs) - min(sps_runs))
+                            / float(np.median(sps_runs)), 1),
+    }
+    try:
+        with open(BASELINE_PATH) as f:
+            base = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        base = {}
+    base["pinned_baseline"] = pin
+    tmp = BASELINE_PATH + ".pin.tmp"
+    with open(tmp, "w") as f:
+        json.dump(base, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, BASELINE_PATH)
+    print(json.dumps(pin))
+
+
 def _last_good():
-    """Most recent watcher-harvested full result, if any."""
+    """Most recent watcher-harvested full result, if any.
+
+    A result carrying ``value_source`` is itself a promotion of an
+    older capture (the TPU was unreachable when it was produced) —
+    never re-accept one as a fresh capture, or a week-old number could
+    be re-dated on every watcher cycle. The capture time comes from
+    the ``captured_at_unix`` stamped INSIDE a fresh chip result (file
+    mtime only as a legacy fallback) for the same reason: an mtime
+    resets whenever anything rewrites the file.
+    """
     try:
         with open(LIVE_PATH) as f:
             j = json.load(f)
-        if j.get("platform") and j["platform"] != "cpu":
-            j["captured_unix_mtime"] = os.path.getmtime(LIVE_PATH)
+        if (j.get("platform") and j["platform"] != "cpu"
+                and not j.get("value_source")):
+            j["captured_unix_mtime"] = j.get(
+                "captured_at_unix", os.path.getmtime(LIVE_PATH))
             return j
     except (OSError, json.JSONDecodeError):
         pass
@@ -762,6 +1062,11 @@ def main():
     ap.add_argument("--run-id", default=None)
     ap.add_argument("--no-tpu", action="store_true",
                     help="skip the TPU child (numpy baseline only)")
+    ap.add_argument("--pin-baseline", nargs="?", const=7, type=int,
+                    default=None, metavar="N",
+                    help="measure the CPU baseline N times and pin the "
+                         "max (incl. historical BENCH_r0*.json "
+                         "observations) into BASELINE.json")
     args = ap.parse_args()
 
     if args.tpu_probe:
@@ -769,6 +1074,9 @@ def main():
         return
     if args.tpu_child:
         _child_main(args.run_id or "adhoc")
+        return
+    if args.pin_baseline is not None:
+        _pin_baseline_main(max(3, args.pin_baseline))
         return
 
     deadline = start + float(os.environ.get("BENCH_SELF_DEADLINE", "540"))
@@ -801,12 +1109,22 @@ def main():
         t_v = _time(viterbi_decode_native, dep, reps=5, fence=lambda o: None)
         vit_c_mbps = round(nb / t_v / 1e6, 2)
 
+    # the ratio denominator: the pinned, load-isolated baseline if one
+    # is committed (BASELINE.json pinned_baseline), else this run's
+    # measurement. The this-run number is always reported alongside so
+    # host-load contamination of the box is visible, not hidden.
+    pin = _pinned_baseline()
+    denom = pin["sps"] if pin else sps_np
+
     result = {
         "metric": "80211a_rx_samples_per_sec_per_chip",
         "unit": "samples/s",
         "numpy_baseline_sps": round(sps_np, 1),
         "viterbi_c_simd_mbps": vit_c_mbps,
     }
+    if pin:
+        result["pinned_baseline_sps"] = pin["sps"]
+        result["baseline_pinned_at"] = pin.get("pinned_at")
 
     child, err = None, None
     if args.no_tpu:
@@ -875,28 +1193,52 @@ def main():
 
     if child is not None:
         result["value"] = round(child["tpu_sps"], 1)
-        result["vs_baseline"] = round(child["tpu_sps"] / sps_np, 3)
+        result["vs_baseline"] = round(child["tpu_sps"] / denom, 3)
+        # the capture time rides INSIDE the JSON so later copies /
+        # rewrites of the file cannot re-date the measurement
+        result["captured_at_unix"] = round(time.time(), 1)
         for k in ("platform", "device_kind", "batch", "t_step_s",
                   "t_percall_s", "t_percall_batch",
                   "fence_audit_bur_over_copy",
                   "timing_method", "pallas_mosaic", "roofline",
                   "batch_sweep", "framebatch", "fxp_interior",
-                  "frame_bytes", "partial"):
+                  "frame_bytes", "partial", "resumed_stages"):
             if k in child:
                 result[k] = child.get(k)
         if err:
             result["tpu_error"] = err
     else:
-        # TPU unreachable this run: record the baseline so the round
-        # has data, plus the watcher's most recent full capture if one
-        # exists (clearly labelled as from an earlier healthy window).
-        result["value"] = round(sps_np, 1)
-        result["vs_baseline"] = 1.0
-        result["tpu"] = "unavailable"
+        # TPU unreachable this run. A recent watcher-harvested capture
+        # is promoted to the FIRST-CLASS headline (VERDICT r4 weak #1:
+        # four rounds of "value = CPU baseline" buried the real chip
+        # number in a nested appendix), clearly labelled with its
+        # capture time; the full capture rides along as last_good.
+        result["tpu"] = "unavailable_this_invocation"
         result["tpu_error"] = err
         lg = _last_good()
         if lg is not None:
             result["last_good"] = lg
+        age_h = (None if lg is None else
+                 (time.time() - lg["captured_unix_mtime"]) / 3600.0)
+        if lg is not None and age_h < 24.0:
+            result["value"] = lg["value"]
+            result["vs_baseline"] = round(lg["value"] / denom, 3)
+            for k in ("platform", "device_kind", "batch", "t_step_s",
+                      "timing_method", "pallas_mosaic", "roofline",
+                      "partial"):
+                if k in lg:
+                    result[k] = lg[k]
+            result["value_source"] = (
+                "watcher-harvested TPU capture "
+                + time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                time.gmtime(lg["captured_unix_mtime"]))
+                + f" ({age_h:.1f}h before this invocation); backend "
+                  "was unreachable during this invocation itself")
+        else:
+            # no chip capture fresh enough to stand behind: the
+            # baseline is the only honest number this invocation has
+            result["value"] = round(sps_np, 1)
+            result["vs_baseline"] = round(sps_np / denom, 3)
 
     result["bench_wall_s"] = round(time.time() - start, 1)
     print(json.dumps(result))
