@@ -225,3 +225,28 @@ def test_fx_overflowing_float_wrap_deterministic(backend):
     wrap = lambda v: ((int(round(v)) + 2**15) % 2**16) - 2**15  # noqa
     want = np.stack([[wrap(c.real), wrap(c.imag)] for c in f])
     np.testing.assert_array_equal(out.astype(np.int64), want)
+
+
+def test_in_trace_probe_works_on_this_jax():
+    """ADVICE r4: _in_trace() probes the private jax._src.core
+    trace_ctx API. If a JAX upgrade moves the attribute, the fallback
+    silently disables the device-constant cache (perf-only) — this
+    test turns that silent regression into a visible failure on the
+    pinned JAX version."""
+    import jax
+    import jax.numpy as jnp
+
+    from ziria_tpu.ops import fxp
+
+    assert fxp._in_trace() is False      # eval context
+    seen = {}
+
+    @jax.jit
+    def f(x):
+        seen["in_trace"] = fxp._in_trace()
+        return x + 1
+
+    f(jnp.int32(1))
+    assert seen["in_trace"] is True      # jit trace context
+    # and the probe path itself did not fall back with a warning
+    assert fxp._TRACE_PROBE_WARNED is False

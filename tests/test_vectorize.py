@@ -208,3 +208,36 @@ def test_run_vect_rate_change_pipeline():
     want = run(prog, list(xs)).out_array()
     got = run_vect(prog, xs)
     assert_stream_eq(np.asarray(got), want, name="run_vect rates")
+
+
+def test_model_constants_platform_keyed_and_measured():
+    """VERDICT r4 next #6: the utility constants carry a measured
+    pedigree per platform. The cpu row is fitted from the committed
+    VECT_CALIB_CPU.json probe tables; the tpu row stays an
+    architectural estimate until VECT_CALIB.json (chip fit) lands, at
+    which point model_constants() prefers its fitted_constants block
+    automatically."""
+    from ziria_tpu.core.vectorize import MODEL_CONSTANTS, model_constants
+
+    cpu = model_constants("cpu")
+    tpu = model_constants("tpu")
+    assert "measured" in cpu["pedigree"]
+    assert (cpu["vpu_parallel"], cpu["step_overhead"]) != \
+        (tpu["vpu_parallel"], tpu["step_overhead"])
+    # under the test conftest jax is pinned to cpu -> active platform
+    # resolves to the measured row
+    assert model_constants()["pedigree"] == cpu["pedigree"]
+    # a measured fact the fit encodes: CPU per-step overhead is far
+    # larger relative to item cost than the TPU guess assumed, so a
+    # scan-bound pipeline widens its pick under the cpu constants
+    import ziria_tpu as z
+    from ziria_tpu.core import ir as _ir
+    from ziria_tpu.core.card import steady_state
+
+    prog = z.pipe(z.map_accum(lambda s, x: (s + x, s + x), 0.0))
+    ss = steady_state(_ir.pipeline_stages(prog))
+    W_cpu, _ = search_width(ss, _ir.pipeline_stages(prog),
+                            constants=MODEL_CONSTANTS["cpu"])
+    W_tpu, _ = search_width(ss, _ir.pipeline_stages(prog),
+                            constants=MODEL_CONSTANTS["tpu"])
+    assert W_cpu > W_tpu

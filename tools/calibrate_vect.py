@@ -98,6 +98,47 @@ def _time_width(comp, W: int):
     return max((t2 - t1) / (K2 - K1), 1e-9), take
 
 
+def _fit_constants(pipelines: dict) -> dict:
+    """Fit the utility model's two constants from the probe tables.
+
+    Model: s_per_step(W) = a + b_par*(parallel items) + b_seq*(seq
+    items). The stateless pipeline (2 vmapped stages -> 2W parallel
+    items/step) yields b_par from its lstsq slope; the stateful one
+    (1 scan -> W sequential items/step) yields b_seq; the stateless
+    intercept estimates the fixed per-step cost a. Then, in the
+    model's own units (a sequential item costs 1):
+
+        VPU_PARALLEL  = b_seq / b_par   (parallel items per seq-item)
+        STEP_OVERHEAD = a / b_seq       (seq-item-equivalents)
+
+    Per-regime fits are used instead of one global lstsq because the
+    captures are noisy (host load, cache cliffs at multi-MB widths) —
+    a shared intercept fits nothing well. Treat results as
+    2-significant-figure estimates.
+    """
+    def slope_intercept(name):
+        tab = pipelines[name]["table"]
+        W = np.array([r["W"] for r in tab], float)
+        t = np.array([r["s_per_step"] for r in tab], float)
+        b, a = np.polyfit(W, t, 1)
+        return b, max(a, 0.0)
+
+    b_sl, a_sl = slope_intercept("stateless")   # slope = 2*b_par
+    b_sf, _ = slope_intercept("stateful")       # slope = b_seq
+    #                         (its intercept is unused: STEP_OVERHEAD
+    #                          derives from the stateless fit's a_sl)
+    b_par = max(b_sl / 2.0, 1e-15)
+    b_seq = max(b_sf, 1e-15)
+    return {
+        "a_s": round(float(a_sl), 9),
+        "b_par_s": round(float(b_par), 12),
+        "b_seq_s": round(float(b_seq), 12),
+        "VPU_PARALLEL": round(float(b_seq / b_par), 1),
+        "STEP_OVERHEAD": round(float(a_sl / b_seq), 1),
+        "method": "per-regime lstsq (see _fit_constants docstring)",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -130,6 +171,10 @@ def main() -> int:
             "pick_within_10pct":
                 pick_row["items_per_s"] >= 0.9 * best["items_per_s"],
         }
+    try:
+        report["fitted_constants"] = _fit_constants(report["pipelines"])
+    except Exception as e:        # fit is best-effort; tables are the data
+        report["fitted_constants"] = {"error": repr(e)}
     print(json.dumps(report, indent=2))
     ok = all(p["pick_within_10pct"]
              for p in report["pipelines"].values())

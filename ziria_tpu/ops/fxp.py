@@ -41,13 +41,29 @@ _CORDIC_ANGLES = np.round(
     * (Q15_HALF_TURN / np.pi)).astype(np.int32)
 
 
+_TRACE_PROBE_WARNED = False
+
+
 def _in_trace() -> bool:
     """True while some JAX transformation is tracing. Private-API probe
-    with a conservative fallback (assume tracing -> never cache)."""
+    with a conservative fallback (assume tracing -> never cache). The
+    fallback is correct but silently disables the device-constant
+    cache, so it warns once (tests assert the probe works on the
+    pinned JAX version — a version bump that moves the attribute is
+    noticed, not absorbed as a perf regression)."""
     try:
         import jax._src.core as _core
         return _core.trace_ctx.trace is not _core.eval_trace
     except Exception:
+        global _TRACE_PROBE_WARNED
+        if not _TRACE_PROBE_WARNED:
+            _TRACE_PROBE_WARNED = True
+            import warnings
+            warnings.warn(
+                "ziria_tpu.ops.fxp: the jax._src.core.trace_ctx probe "
+                "failed on this JAX version; the device-constant cache "
+                "is disabled (correctness unaffected, Q14 twiddle "
+                "tables rebuild on every call). Update _in_trace().")
         return True
 
 
