@@ -278,6 +278,12 @@ def _load_resume(platform, window_s, now=None, path=PARTIAL_PATH,
                 keys = [rec.get("stage")]
                 if keys[0] == "batch_sweep":
                     keys = [f"batch_sweep:{rec.get('batch')}"]
+                elif keys[0] == "headline" and rec.get("windowed"):
+                    # a windowed-Viterbi promotion is a different
+                    # decode method: it must never shadow the exact
+                    # step at its width (the "windowed" stage record
+                    # is what resumes the measurement itself)
+                    keys = ["headline_windowed"]
                 elif keys[0] == "headline":
                     # a run emits headline at B=128 and again when the
                     # sweep promotes a wider B — keep each width's
@@ -400,8 +406,12 @@ def _child_main(run_id):
     sweep = {}
     width_cap = {}   # batch -> original capture time (resume provenance)
     for key, rec in resume.items():
+        # windowed-Viterbi headline promotions are a different decode
+        # method — they resume via the "windowed" stage and must not
+        # seed the EXACT-decode width table
         if (key.startswith("headline:") or key.startswith("batch_sweep:")) \
-                and "t_step_s" in rec and "batch" in rec:
+                and "t_step_s" in rec and "batch" in rec \
+                and not rec.get("windowed"):
             sweep.setdefault(rec["batch"], rec["t_step_s"])
             width_cap.setdefault(rec["batch"],
                                  rec.get("captured_t", rec["t"]))
@@ -484,14 +494,14 @@ def _child_main(run_id):
             best = min(best, time.perf_counter() - ts)
         return best
 
-    def emit_headline(stage, b, t, method):
+    def emit_headline(stage, b, t, method, **fields):
         """One definition of a measured-throughput partial record, so
         the headline, sweep probes, and promotion can't drift apart.
         A record whose width was NOT measured by this child carries the
         original capture time so chained resumes age out honestly."""
-        extra = {}
+        extra = dict(fields)
         if b not in fresh_widths and b in width_cap:
-            extra["captured_t"] = width_cap[b]
+            extra.setdefault("captured_t", width_cap[b])
         part(stage, tpu_sps=b * frame_len / t, t_step_s=t, batch=b,
              device_kind=getattr(dev, "device_kind", "?"),
              timing_method=method,
@@ -620,6 +630,81 @@ def _child_main(run_id):
             note(f"sweep: promoting B={B} to headline"
                  f" ({sps/1e6:.0f} M sps)")
             emit_headline("headline", B, t_tpu, timing_method)
+
+    # Sliding-window parallel Viterbi (r5): the exact decode's ~8k-step
+    # trellis chain is the suspected bound (see decompose below);
+    # windowing converts that serial depth into batch lanes — the
+    # truncated-traceback trade the reference's own SORA decoder makes,
+    # bit-identical at operating SNR (tests/test_viterbi_windowed.py).
+    # The integrity checksum gates it on-chip before any timing is
+    # recorded; if it beats the exact headline it is promoted with the
+    # method stated in timing_method. ZIRIA_BENCH_WINDOWED=0 disables.
+    def _windowed_stage():
+        if time.time() - t0 > 0.65 * budget:
+            raise TimeoutError("skipped: child time budget")
+        win, ov = 1024, 96
+        dkw = make_decode_k(lambda x: rx.decode_data_batch(
+            x, rate, n_sym, n_psdu_bits, viterbi_window=win)[0])
+        acc = int(dkw(frames, jnp.int32(2)))
+        assert acc == _chk_expected(128, 2), (acc, _chk_expected(128, 2))
+        tw1, tw2 = timed_k(dkw, frames, 8), timed_k(dkw, frames, 40)
+        t_w = (tw2 - tw1) / 32
+        t128 = sweep.get(128, t_tpu)
+        # same glitch guard as the sweep: a marginal step implausibly
+        # below 1/50 of the exact step is a timing artifact
+        if not t_w > 0.02 * t128:
+            raise RuntimeError(
+                f"implausible windowed marginal {t_w*1e3:.4f} ms "
+                f"(exact step {t128*1e3:.3f} ms) — timing glitch")
+        rec = {"batch": 128, "window": win, "overlap": ov,
+               "t_step_s": round(t_w, 6),
+               "tpu_sps": round(128 * frame_len / t_w, 1),
+               "vs_exact_step": round(t_w / t128, 3)}
+        note(f"windowed viterbi: {t_w*1e3:.3f} ms/step "
+             f"({rec['tpu_sps']/1e6:.0f} M sps, "
+             f"{rec['vs_exact_step']:.2f}x the exact step)")
+        part("windowed", **rec)
+        return rec
+
+    windowed_captured_t = None
+    if "windowed" in resume:
+        rec_w = resume["windowed"]
+        windowed_captured_t = rec_w.get("captured_t", rec_w["t"])
+        winrec = reuse(rec_w)
+        note("windowed stage resumed from prior window")
+    elif os.environ.get("ZIRIA_BENCH_WINDOWED", "1") == "0":
+        winrec = {"skipped": "ZIRIA_BENCH_WINDOWED=0"}
+    else:
+        try:
+            winrec = _windowed_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"windowed stage failed: {e!r}")
+            winrec = {"error": repr(e)}
+
+    if (winrec.get("tpu_sps") and
+            winrec["tpu_sps"] > B * frame_len / t_tpu):
+        B, t_tpu = winrec["batch"], winrec["t_step_s"]
+        sps = winrec["tpu_sps"]
+        timing_method = (
+            f"marginal device-loop step (K=8 vs 40), windowed "
+            f"Viterbi (window={winrec['window']}, "
+            f"overlap={winrec['overlap']}; truncated-traceback "
+            f"parallel decode, checksum-gated on-chip)")
+        extra = {"windowed": True, "window": winrec.get("window"),
+                 "overlap": winrec.get("overlap")}
+        if windowed_captured_t is not None:
+            # promotion of a RESUMED windowed measurement: say so and
+            # carry the original capture time so chained resumes age
+            # it out honestly (review finding)
+            timing_method += ", resumed from prior window"
+            extra["captured_t"] = windowed_captured_t
+        else:
+            # freshly measured this run (even when the exact step at
+            # this width was resumed)
+            fresh_widths.add(B)
+        note(f"windowed decode promoted to headline "
+             f"({sps/1e6:.0f} M sps)")
+        emit_headline("headline", B, t_tpu, timing_method, **extra)
 
     # Step decomposition (VERDICT r4 next #3): the B=128 step runs at
     # ~4% of HBM peak — dependency-chain-bound, but WHERE? Time the
@@ -856,6 +941,7 @@ def _child_main(run_id):
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
         "pallas_mosaic": pallas_mosaic,
+        "windowed": winrec,
         "decompose": decomp,
         "framebatch": fb,
         "fxp_interior": fxp_ev,
@@ -1278,7 +1364,7 @@ def main():
                   "t_percall_s", "t_percall_batch",
                   "fence_audit_bur_over_copy",
                   "timing_method", "pallas_mosaic", "roofline",
-                  "batch_sweep", "decompose", "framebatch",
+                  "batch_sweep", "windowed", "decompose", "framebatch",
                   "fxp_interior", "frame_bytes", "partial",
                   "resumed_stages"):
             if k in child:

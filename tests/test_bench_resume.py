@@ -124,3 +124,22 @@ def test_missing_file_is_empty(tmp_path):
     out = bench._load_resume("tpu", 3600, now=NOW,
                              path=str(tmp_path / "nope.jsonl"))
     assert out == {}
+
+
+def test_windowed_headline_never_seeds_exact_width_table(tmp_path):
+    # a windowed-Viterbi promotion is a different decode method: it
+    # must resume under its own key, never shadowing the exact step
+    # at its width — even when it is the LATEST headline record
+    path = _write(tmp_path, [
+        rec("headline", t=NOW - 200, batch=128, t_step_s=1e-3),
+        rec("headline", t=NOW - 100, batch=128, t_step_s=2e-4,
+            windowed=True, window=1024, overlap=96),
+        rec("batch_sweep", batch=256, t_step_s=2e-3),
+    ])
+    out = bench._load_resume("tpu", 3600, now=NOW, path=path)
+    # the exact record survives at its width key...
+    assert out["headline:128"]["t_step_s"] == 1e-3
+    assert "windowed" not in out["headline:128"]
+    # ...and the windowed promotion lives under its own key
+    assert out["headline_windowed"]["windowed"] is True
+    assert out["headline"]["t_step_s"] == 1e-3   # latest EXACT headline

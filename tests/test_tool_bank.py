@@ -1,0 +1,70 @@
+"""Shared scratch-dir resume bank for the TPU harvest tools
+(tools/_bank.py): per-entry aging, platform/match gating, atomicity
+side contracts. Review r5: the first bank implementation re-stamped
+the whole file's age on every write, reviving stale entries — these
+tests pin the per-entry rule."""
+
+import importlib.util
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bank(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "_bank", os.path.join(REPO, "tools", "_bank.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "SCRATCH", str(tmp_path))
+    return mod
+
+
+def test_entries_age_individually(tmp_path, monkeypatch):
+    bank = _bank(tmp_path, monkeypatch)
+    bank.save_entry("b", "tpu", "old", {"v": 1})
+    # simulate a much later write of a second entry
+    bank.save_entry("b", "tpu", "new", {"v": 2})
+    now = time.time()
+    out = bank.load_bank("b", "tpu", now=now + 7 * 3600)
+    assert out == {}                       # both aged out
+    out = bank.load_bank("b", "tpu", now=now)
+    assert set(out) == {"old", "new"}
+    # an old entry does NOT ride a fresh one's timestamp: age the
+    # first artificially and confirm only it drops
+    saved = bank.load_bank("b", "tpu", now=now)
+    assert saved["old"]["_t"] <= saved["new"]["_t"]
+    import json
+    with open(os.path.join(str(tmp_path), "b.json")) as f:
+        j = json.load(f)
+    j["entries"]["old"]["_t"] = now - 7 * 3600
+    with open(os.path.join(str(tmp_path), "b.json"), "w") as f:
+        json.dump(j, f)
+    out = bank.load_bank("b", "tpu", now=now)
+    assert set(out) == {"new"}
+
+
+def test_platform_and_match_gate(tmp_path, monkeypatch):
+    bank = _bank(tmp_path, monkeypatch)
+    bank.save_entry("b", "tpu", "k", {"v": 1}, match={"T": 8208})
+    assert bank.load_bank("b", "cpu") == {}
+    assert bank.load_bank("b", "tpu", match={"T": 1040}) == {}
+    assert "k" in bank.load_bank("b", "tpu", match={"T": 8208})
+    # a write under a different match discards the stale bank
+    bank.save_entry("b", "tpu", "k2", {"v": 2}, match={"T": 1040})
+    out = bank.load_bank("b", "tpu", match={"T": 1040})
+    assert set(out) == {"k2"}
+
+
+def test_strip_removes_bookkeeping(tmp_path, monkeypatch):
+    bank = _bank(tmp_path, monkeypatch)
+    bank.save_entry("b", "tpu", "k", {"v": 1})
+    e = bank.load_bank("b", "tpu")["k"]
+    assert bank.strip(e) == {"v": 1}
+
+
+def test_corrupt_file_is_empty(tmp_path, monkeypatch):
+    bank = _bank(tmp_path, monkeypatch)
+    with open(os.path.join(str(tmp_path), "b.json"), "w") as f:
+        f.write("not json")
+    assert bank.load_bank("b", "tpu") == {}

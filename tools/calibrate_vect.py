@@ -154,30 +154,20 @@ def main() -> int:
 
     # per-pipeline resume across window flaps (same idea as bench.py's
     # stage resume): each finished pipeline is banked in the scratch
-    # dir; a re-entering run on the same platform within 6 h reuses it
-    # and spends the (possibly short) window on what is missing.
-    scratch = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "..", ".bench_scratch")
-    os.makedirs(scratch, exist_ok=True)
-    bank_path = os.path.join(scratch, f"vect_calib_{dev.platform}.json")
-    bank = {}
-    try:
-        with open(bank_path) as f:
-            saved = json.load(f)
-        if (saved.get("platform") == dev.platform
-                and time.time() - saved.get("t", 0) < 6 * 3600):
-            bank = saved.get("pipelines", {})
-            if bank:
-                print(f"[calibrate] resuming {sorted(bank)} from "
-                      f"{bank_path}", file=sys.stderr, flush=True)
-    except (OSError, json.JSONDecodeError):
-        pass
+    # dir with its own capture time; a re-entering run on the same
+    # platform reuses the still-fresh ones and spends the (possibly
+    # short) window on what is missing.
+    import _bank
+    bank = _bank.load_bank("vect_calib", dev.platform)
+    if bank:
+        print(f"[calibrate] resuming {sorted(bank)} from the scratch "
+              f"bank", file=sys.stderr, flush=True)
 
     report = {"device": str(dev), "platform": dev.platform,
               "pipelines": {}}
     for name, comp in _pipelines():
         if name in bank:
-            report["pipelines"][name] = bank[name]
+            report["pipelines"][name] = _bank.strip(bank[name])
             continue
         plan = vectorize(comp)
         pick = plan.segments[0].width if plan.segments else 1
@@ -195,12 +185,8 @@ def main() -> int:
             "pick_within_10pct":
                 pick_row["items_per_s"] >= 0.9 * best["items_per_s"],
         }
-        bank[name] = report["pipelines"][name]
-        tmp = bank_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"platform": dev.platform, "t": time.time(),
-                       "pipelines": bank}, f)
-        os.replace(tmp, bank_path)
+        _bank.save_entry("vect_calib", dev.platform, name,
+                         report["pipelines"][name])
         print(f"[calibrate] banked {name}", file=sys.stderr, flush=True)
     try:
         report["fitted_constants"] = _fit_constants(report["pipelines"])

@@ -71,38 +71,17 @@ def main():
 
     # per-point resume across window flaps (same idea as bench.py's
     # stage resume): finished B points are banked in the scratch dir
-    # keyed by platform+T, so a window that dies after B=256 spends
-    # its successor on 512/1024 instead of re-measuring.
-    scratch = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "..", ".bench_scratch")
-    os.makedirs(scratch, exist_ok=True)
-    bank_path = os.path.join(scratch,
-                             f"vit_sweep_{dev.platform}_{T}.json")
-    bank = {}
-    try:
-        with open(bank_path) as f:
-            saved = json.load(f)
-        if (saved.get("platform") == dev.platform
-                and saved.get("T") == T
-                and time.time() - saved.get("t", 0) < 6 * 3600):
-            bank = saved.get("points", {})
-            if bank:
-                print(f"[sweep] resuming B={sorted(bank)} from "
-                      f"{bank_path}", file=sys.stderr, flush=True)
-    except (OSError, json.JSONDecodeError):
-        pass
-
-    def bank_point(B, point):
-        bank[str(B)] = point
-        tmp = bank_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"platform": dev.platform, "T": T,
-                       "t": time.time(), "points": bank}, f)
-        os.replace(tmp, bank_path)
+    # keyed by platform+T with per-point capture times, so a window
+    # that dies after B=256 spends its successor on 512/1024.
+    import _bank
+    bank = _bank.load_bank("vit_sweep", dev.platform, match={"T": T})
+    if bank:
+        print(f"[sweep] resuming B={sorted(bank)} from the scratch "
+              f"bank", file=sys.stderr, flush=True)
 
     for B in ((128, 256) if smoke else (128, 256, 512, 1024)):
         if str(B) in bank:
-            out["points"].append(bank[str(B)])
+            out["points"].append(_bank.strip(bank[str(B)]))
             continue
         llrs = jnp.asarray(rng.normal(size=(B, T, 2)).astype(np.float32))
         full = jax.jit(lambda x: vp.viterbi_decode_batch(
@@ -136,7 +115,8 @@ def main():
             "mbit_per_s_kernel": round(B * T / t_kern / 1e6, 1),
         }
         out["points"].append(point)
-        bank_point(B, point)
+        _bank.save_entry("vit_sweep", dev.platform, str(B), point,
+                         match={"T": T})
         print(f"[sweep] B={B}: full {t_full*1e3:.2f} ms, kernel "
               f"{t_kern*1e3:.2f} ms", file=sys.stderr, flush=True)
 

@@ -119,17 +119,30 @@ def decode_data_static(frame, rate: RateParams, n_sym: int,
 
 
 def decode_data_batch(frames, rate: RateParams, n_sym: int,
-                      n_psdu_bits: int, interpret: bool = None):
+                      n_psdu_bits: int, interpret: bool = None,
+                      viterbi_window: int = None):
     """Batched DATA decode: (B, frame_len, 2) -> ((B, n_psdu_bits),
     (B, 16)).
 
     The TPU fast path: the per-frame front end (FFT/equalize/demap/...)
     runs under vmap, then the whole batch hits the Pallas Viterbi kernel
     with frames laid out across the 128 VPU lanes (~8x the vmapped
-    lax.scan ACS; see ops/viterbi_pallas.py)."""
+    lax.scan ACS; see ops/viterbi_pallas.py).
+
+    ``viterbi_window`` opts into the sliding-window PARALLEL Viterbi
+    (viterbi_decode_batch_windowed): the ~8k-step sequential trellis is
+    cut into overlapping windows decoded as extra batch lanes — the
+    standard truncated-traceback trade every production decoder
+    (including the reference's SORA brick) makes, bit-identical to the
+    exact decode at operating SNR (tests/test_viterbi_windowed.py)."""
     dep = jax.vmap(lambda f: _decode_front(f, rate, n_sym))(frames)
-    bits = viterbi_pallas.viterbi_decode_batch(
-        dep, n_bits=n_sym * rate.n_dbps, interpret=interpret)
+    if viterbi_window:
+        bits = viterbi_pallas.viterbi_decode_batch_windowed(
+            dep, n_bits=n_sym * rate.n_dbps, window=viterbi_window,
+            interpret=interpret)
+    else:
+        bits = viterbi_pallas.viterbi_decode_batch(
+            dep, n_bits=n_sym * rate.n_dbps, interpret=interpret)
     return jax.vmap(lambda b: _decode_back(b, n_psdu_bits))(bits)
 
 
