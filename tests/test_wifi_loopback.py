@@ -147,3 +147,23 @@ def test_bucketed_equals_static_decode():
         got = np.asarray(clear)[16: 16 + 8 * (n_bytes + 4)]
         assert_stream_eq(got, np.asarray(want),
                          name=f"bucketed-vs-static@{rate}")
+
+
+def test_receive_windowed_viterbi_matches_exact():
+    """receive(viterbi_window=...) — the sliding-window parallel
+    Viterbi serving the single-frame driver — returns the identical
+    PSDU (and FCS verdict) as the exact decode on an impaired capture
+    long enough to actually window (>= 2 windows of 512)."""
+    psdu, bits, wave = make_frame(54, n_bytes=200)
+    k1, k2, _ = jax.random.split(KEY, 3)
+    x = channel.delay(k1, wave, n_before=120, n_after=80)
+    x = channel.apply_cfo(x, 1e-4)
+    x = np.asarray(channel.awgn(k2, x, snr_db=26.0))
+    exact = rx.receive(x, check_fcs=True)
+    win = rx.receive(x, check_fcs=True, viterbi_window=512)
+    assert exact.ok and win.ok
+    assert win.rate_mbps == exact.rate_mbps
+    assert win.length_bytes == exact.length_bytes
+    assert bool(win.crc_ok) and bool(exact.crc_ok)
+    np.testing.assert_array_equal(win.psdu_bits, exact.psdu_bits)
+    assert_stream_eq(win.psdu_bits[: 8 * 200], bits, name="rx-windowed")

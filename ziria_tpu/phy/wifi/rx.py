@@ -193,7 +193,7 @@ class RxResult(NamedTuple):
 
 
 def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
-                         n_bits_real):
+                         n_bits_real, viterbi_window: int = None):
     """DATA decode over a *bucketed* symbol count: `frame` is padded to
     FRAME_DATA_START + 80*n_sym_bucket samples, `n_bits_real` is the
     true data-bit count as a TRACED scalar. Returns the full descrambled
@@ -208,15 +208,25 @@ def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
     depunct = _decode_front(frame, rate, n_sym_bucket)   # (T_b, 2)
     t = jnp.arange(depunct.shape[0])
     depunct = jnp.where((t < n_bits_real)[:, None], depunct, 0.0)
-    bits = viterbi.viterbi_decode(
-        depunct, n_bits=n_sym_bucket * rate.n_dbps)
+    if viterbi_window:
+        # the windowed PARALLEL decoder: this single frame's windows
+        # become a small batch through the Pallas kernel, cutting the
+        # sequential trellis depth ~T/window-fold (see
+        # ops/viterbi_pallas.viterbi_decode_batch_windowed)
+        bits = viterbi_pallas.viterbi_decode_batch_windowed(
+            depunct[None], n_bits=n_sym_bucket * rate.n_dbps,
+            window=viterbi_window)[0]
+    else:
+        bits = viterbi.viterbi_decode(
+            depunct, n_bits=n_sym_bucket * rate.n_dbps)
     seed = scramble.recover_seed(bits[:7])
     return scramble.descramble_bits(bits, seed)
 
 
 @lru_cache(maxsize=None)
 def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
-                              fxp: bool = False):
+                              fxp: bool = False,
+                              viterbi_window: int = None):
     rate = RATES[rate_mbps]
 
     if fxp:
@@ -228,7 +238,7 @@ def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
     else:
         def f(frame, n_bits_real):
             return decode_data_bucketed(frame, rate, n_sym_bucket,
-                                        n_bits_real)
+                                        n_bits_real, viterbi_window)
 
     return jax.jit(f)
 
@@ -244,7 +254,8 @@ _jit_signal = None
 
 
 def receive(samples, check_fcs: bool = False,
-            max_samples: int = 1 << 16, fxp: bool = False) -> RxResult:
+            max_samples: int = 1 << 16, fxp: bool = False,
+            viterbi_window: int = None) -> RxResult:
     """Host-side receiver driver: detect, align, CFO-correct, parse
     SIGNAL, dispatch the per-rate decoder — the jit analogue of the
     reference's header-driven rate dispatch. The data decode compiles
@@ -259,6 +270,11 @@ def receive(samples, check_fcs: bool = False,
     fixed-point boundary, after which every decode op is exact integer
     arithmetic (bit-identical across backends for identical quantized
     input).
+
+    viterbi_window opts the (float) DATA decode into the sliding-
+    window parallel Viterbi — same result at operating SNR, ~T/window
+    less sequential trellis depth on the chip (ignored under fxp,
+    whose decode keeps the exact scan).
     """
     global _jit_sync, _jit_signal
     if _jit_sync is None:
@@ -320,7 +336,8 @@ def receive(samples, check_fcs: bool = False,
         rms = float(np.sqrt(np.mean(frame_np[:320].astype(np.float64)
                                     ** 2) * 2.0))
         seg = rx_fxp.quantize_frame(np.asarray(seg) / max(rms, 1e-12))
-    dec = _jit_decode_data_bucketed(rate_mbps, n_sym_b, fxp)
+    dec = _jit_decode_data_bucketed(rate_mbps, n_sym_b, fxp,
+                                    None if fxp else viterbi_window)
     clear = np.asarray(
         dec(seg, jnp.int32(n_sym * rate.n_dbps)), np.uint8)
     psdu = clear[N_SERVICE_BITS: N_SERVICE_BITS + 8 * length_bytes]
