@@ -89,3 +89,25 @@ def test_golden(name, mode, atol, tmp_path):
     else:
         rep = stream_diff(got, want, name=name)
     assert rep, rep.message
+
+
+def test_wifi_rx_golden_with_windowed_viterbi(tmp_path, monkeypatch):
+    """--viterbi-window routes the compiled DSL receiver's viterbi_soft
+    ext through the sliding-window parallel decode; the golden capture
+    must replay byte-identically (same driver invocation the judge
+    uses, plus the flag)."""
+    name, mode = "wifi_rx", "bin"
+    src = os.path.join(EXAMPLES, f"{name}.zir")
+    infile = os.path.join(GOLD, f"{name}.infile")
+    ground = os.path.join(GOLD, f"{name}.outfile.ground")
+    outf = tmp_path / "out.bin"
+    monkeypatch.delenv("ZIRIA_VITERBI_WINDOW", raising=False)
+    rc = cli_main([
+        f"--src={src}", "--input=file", f"--input-file-name={infile}",
+        f"--input-file-mode={mode}", "--output=file",
+        f"--output-file-name={outf}", f"--output-file-mode={mode}",
+        "--backend=hybrid", "--viterbi-window=256", "--platform=cpu",
+    ])
+    assert rc == 0
+    with open(outf, "rb") as f1, open(ground, "rb") as f2:
+        assert f1.read() == f2.read()

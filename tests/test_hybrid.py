@@ -300,3 +300,27 @@ def test_print_blocks_never_wrapped():
 
     walk(hyb)
     assert not found
+
+
+def test_viterbi_soft_windowed_flag(monkeypatch):
+    """ZIRIA_VITERBI_WINDOW routes every STAGED viterbi_soft through
+    the sliding-window parallel Pallas decode — same bits on a real
+    coded stream, no program change (the --viterbi-window driver
+    flag's contract)."""
+    from ziria_tpu.frontend.externals import EXTERNALS
+    from ziria_tpu.ops import coding
+    vs = EXTERNALS["viterbi_soft"]
+    rng = np.random.default_rng(5)
+    n = 600
+    bits = rng.integers(0, 2, n).astype(np.uint8)
+    bits[-coding.K + 1:] = 0
+    coded = np.asarray(coding.np_conv_encode_ref(bits), np.float32)
+    llrs = ((2.0 * coded - 1.0) * 3.0
+            + rng.normal(0, 1.0, coded.size)).astype(np.float32)
+    monkeypatch.delenv("ZIRIA_VITERBI_WINDOW", raising=False)
+    exact = np.asarray(jax.jit(lambda x: vs(x, n, n))(jnp.asarray(llrs)))
+    # window=256 << n: the staged call genuinely windows (3 windows)
+    monkeypatch.setenv("ZIRIA_VITERBI_WINDOW", "256")
+    win = np.asarray(jax.jit(lambda x: vs(x, n, n))(jnp.asarray(llrs)))
+    np.testing.assert_array_equal(win, exact)
+    np.testing.assert_array_equal(win[:n], bits)

@@ -81,6 +81,34 @@ def main() -> int:
           and np.array_equal(a, np.asarray(r2.out_array()))
           and a.shape[0] == 8 * 90)
 
+    # the same compiled receiver under --viterbi-window (r5): the
+    # sliding-window parallel decode must produce the identical bits
+    # and its warm time is the DSL path's chip gain from cutting the
+    # trellis dependency chain
+    win_ev = None
+    try:
+        os.environ["ZIRIA_VITERBI_WINDOW"] = "512"
+        hyb_w = hybridize(compile_file("examples/wifi_rx.zir").comp)
+        t0 = time.perf_counter()
+        rw1 = run(hyb_w, [p for p in xi])
+        t_wcold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        rw2 = run(hyb_w, [p for p in xi])
+        t_wwarm = time.perf_counter() - t0
+        aw = np.asarray(rw1.out_array())
+        win_ev = {
+            "identical": bool(np.array_equal(aw, a) and np.array_equal(
+                aw, np.asarray(rw2.out_array()))),
+            "window": 512,
+            "t_cold_s": round(t_wcold, 3),
+            "t_warm_s": round(t_wwarm, 3),
+        }
+    except Exception as e:              # evidence extra: never fatal
+        win_ev = {"error": repr(e)}
+    finally:
+        os.environ.pop("ZIRIA_VITERBI_WINDOW", None)
+    ok = ok and bool(win_ev.get("identical", True))
+
     # FIXED-POINT cross-backend exactness, measured: replay the
     # checked-in wifi_rx_fxp golden ON THIS BACKEND and require
     # byte-identity with the ground file that CPU CI pins
@@ -114,6 +142,7 @@ def main() -> int:
         "t_cold_s": round(t_cold, 3),
         "t_warm_s": round(t_warm, 3),
         "bits": int(a.shape[0]),
+        "windowed_viterbi": win_ev,
         "fxp_golden_identical": bool(fxp_ok),
         "t_fxp_cold_s": round(t_fxp, 3),
     }))

@@ -225,12 +225,32 @@ def _viterbi_soft(llrs, npairs, nbits):
     nbits = int(np.asarray(nbits))
     if isinstance(llrs, Tracer):
         # staged call (jit / hybrid do-block): static lengths make the
-        # shapes static, so decode with the lax.scan ACS kernel
-        import jax.numpy as jnp
+        # shapes static, so decode with the lax.scan ACS kernel — or,
+        # under the driver flag --viterbi-window / ZIRIA_VITERBI_WINDOW,
+        # the sliding-window PARALLEL Pallas decode: every compiled
+        # program's hot brick accelerates without a source change (the
+        # "one compiler serves every program" property; same result at
+        # operating SNR, tests/test_viterbi_windowed.py). Read at trace
+        # time: set the flag before compiling, not between runs.
+        import os as _os
 
-        from ziria_tpu.ops.viterbi import viterbi_decode
+        import jax.numpy as jnp
         arr = jnp.asarray(llrs, jnp.float32)
-        bits = viterbi_decode(arr[: 2 * npairs], n_bits=nbits)
+        try:
+            win = int(_os.environ.get("ZIRIA_VITERBI_WINDOW", "0"))
+        except ValueError:
+            win = 0
+        from ziria_tpu.ops import viterbi_pallas as _vp
+        if win > 0 and npairs > win + 2 * _vp.DEFAULT_WINDOW_OVERLAP:
+            # only frames long enough to actually window: short
+            # decodes (e.g. the 48-step SIGNAL field on the sync hot
+            # path) keep the scan kernel — the flag is a pure
+            # optimization, never a kernel-launch tax (review r5)
+            bits = _vp.viterbi_decode_batch_windowed(
+                arr[None, : 2 * npairs], n_bits=nbits, window=win)[0]
+        else:
+            from ziria_tpu.ops.viterbi import viterbi_decode
+            bits = viterbi_decode(arr[: 2 * npairs], n_bits=nbits)
         out = jnp.zeros(arr.shape[0] // 2, jnp.uint8)
         return out.at[:nbits].set(bits.astype(jnp.uint8))
     arr = np.asarray(llrs, np.float32)

@@ -246,8 +246,12 @@ def viterbi_decode_batch(llrs, n_bits: int = None, interpret: bool = None):
     return bits
 
 
+DEFAULT_WINDOW_OVERLAP = 96   # ~14 constraint lengths of warmup
+
+
 def viterbi_decode_batch_windowed(llrs, n_bits: int = None,
-                                  window: int = 1024, overlap: int = 96,
+                                  window: int = 1024,
+                                  overlap: int = DEFAULT_WINDOW_OVERLAP,
                                   interpret: bool = None):
     """Sliding-window PARALLEL decode: cut the T-step dependency chain
     into ceil(T/window) overlapping windows and run them as EXTRA BATCH

@@ -222,6 +222,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--platform", default=None,
                    help="pin the JAX platform (e.g. cpu, tpu) before "
                         "backend init; also via ZIRIA_PLATFORM env var")
+    p.add_argument("--viterbi-window", type=int, default=None,
+                   metavar="N",
+                   help="decode every staged viterbi_soft ext with the "
+                        "sliding-window PARALLEL Pallas Viterbi "
+                        "(window N, e.g. 1024): ~T/N less sequential "
+                        "trellis depth on chip, same result at "
+                        "operating SNR; also via ZIRIA_VITERBI_WINDOW")
     return p
 
 
@@ -437,6 +444,24 @@ def main(argv=None) -> int:
     if rc is not None:
         return rc
 
+    if args.viterbi_window is None:
+        return _main_run(args)
+    # the staged viterbi_soft ext reads the env at trace time; scope
+    # the write to this invocation so in-process callers (tests,
+    # embedders) never inherit it, and let --viterbi-window=0
+    # force-disable an exported ZIRIA_VITERBI_WINDOW (review r5)
+    prev = os.environ.get("ZIRIA_VITERBI_WINDOW")
+    os.environ["ZIRIA_VITERBI_WINDOW"] = str(args.viterbi_window)
+    try:
+        return _main_run(args)
+    finally:
+        if prev is None:
+            os.environ.pop("ZIRIA_VITERBI_WINDOW", None)
+        else:
+            os.environ["ZIRIA_VITERBI_WINDOW"] = prev
+
+
+def _main_run(args) -> int:
     if args.scan:
         return _run_scan(args)
 
