@@ -307,16 +307,21 @@ def test_viterbi_soft_windowed_flag(monkeypatch):
     the sliding-window parallel Pallas decode — same bits on a real
     coded stream, no program change (the --viterbi-window driver
     flag's contract)."""
+    import importlib.util
+    import os as _os
+
     from ziria_tpu.frontend.externals import EXTERNALS
-    from ziria_tpu.ops import coding
     vs = EXTERNALS["viterbi_soft"]
+    _spec = importlib.util.spec_from_file_location(
+        "windowed_ber", _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            "tools", "windowed_ber.py"))
+    _wb = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_wb)
     rng = np.random.default_rng(5)
     n = 600
-    bits = rng.integers(0, 2, n).astype(np.uint8)
-    bits[-coding.K + 1:] = 0
-    coded = np.asarray(coding.np_conv_encode_ref(bits), np.float32)
-    llrs = ((2.0 * coded - 1.0) * 3.0
-            + rng.normal(0, 1.0, coded.size)).astype(np.float32)
+    msgs, frames = _wb.make_coded_frames(rng, 1, n, amp=3.0)
+    bits, llrs = msgs[0], frames[0].reshape(-1)
     monkeypatch.delenv("ZIRIA_VITERBI_WINDOW", raising=False)
     exact = np.asarray(jax.jit(lambda x: vs(x, n, n))(jnp.asarray(llrs)))
     # window=256 << n: the staged call genuinely windows (3 windows)

@@ -1,0 +1,96 @@
+"""BER study: windowed parallel Viterbi vs the exact decode.
+
+The windowed decoder's accuracy rests on the truncated-traceback
+argument (survivors of the K=7 code merge within ~5-10 constraint
+lengths). This study MEASURES that claim where it could fail — low
+SNR — by decoding the same noisy frames with the exact decoder and
+with the windowed math at several overlaps, and reporting BER plus
+the windowed-vs-exact disagreement rate.
+
+The windowing math under test is the production implementation
+(ops/viterbi_pallas.viterbi_decode_batch_windowed) with the lax.scan
+engine injected via its ``_decode`` hook, so CPU runs measure exactly
+the shipped window/overlap/stitch logic without interpret-mode Pallas
+cost. Output: one JSON object (committed into docs/windowed_viterbi.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+def make_coded_frames(rng, n_frames, n_bits, amp):
+    """Terminated K=7 frames + AWGN LLRs at amplitude ``amp`` — THE
+    signal recipe shared by this study, its guard tests, and the
+    staged-ext flag test (one definition so they can never measure
+    different signals; review r5). Returns (msgs (F, n), llrs
+    (F, n, 2) float32)."""
+    from ziria_tpu.ops import coding
+    msgs, llrs = [], []
+    for _ in range(n_frames):
+        bits = rng.integers(0, 2, n_bits).astype(np.uint8)
+        bits[-coding.K + 1:] = 0          # zero-tail termination
+        coded = np.asarray(coding.np_conv_encode_ref(bits), np.float32)
+        llr = (2.0 * coded - 1.0) * amp + rng.normal(0, 1.0, coded.size)
+        msgs.append(bits)
+        llrs.append(llr.astype(np.float32).reshape(-1, 2))
+    return np.stack(msgs), np.stack(llrs)
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ziria_tpu.ops import viterbi, viterbi_pallas
+
+    def scan_engine(x):
+        return jax.vmap(viterbi.viterbi_decode)(x)
+
+    rng = np.random.default_rng(2026)
+    n_bits, n_frames = 4096, 16
+    window = 512
+    out = {"n_bits": n_bits, "n_frames": n_frames, "window": window,
+           "engine": "lax.scan via _decode hook (same windowing math "
+                     "as the Pallas path)",
+           "points": []}
+
+    for amp in (0.5, 0.7, 0.9, 1.2):
+        msgs, llrs = make_coded_frames(rng, n_frames, n_bits, amp)
+        llrs = jnp.asarray(llrs)
+
+        exact = np.asarray(scan_engine(llrs))
+        total = msgs.size
+        point = {"llr_amp": amp,
+                 "ber_exact": round(int((exact != msgs).sum()) / total,
+                                    6),
+                 "overlaps": {}}
+        for overlap in (32, 64, 96):
+            win = np.asarray(viterbi_pallas.viterbi_decode_batch_windowed(
+                llrs, window=window, overlap=overlap,
+                _decode=scan_engine))
+            point["overlaps"][str(overlap)] = {
+                "ber": round(int((win != msgs).sum()) / total, 6),
+                "disagree_vs_exact":
+                    round(int((win != exact).sum()) / total, 6),
+            }
+        out["points"].append(point)
+        print(f"[ber] amp={amp}: exact {point['ber_exact']:.2e}, "
+              + ", ".join(
+                  f"ov{o}: {v['ber']:.2e} (diff {v['disagree_vs_exact']:.2e})"
+                  for o, v in point["overlaps"].items()),
+              file=sys.stderr, flush=True)
+
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

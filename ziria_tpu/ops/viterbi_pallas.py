@@ -252,7 +252,8 @@ DEFAULT_WINDOW_OVERLAP = 96   # ~14 constraint lengths of warmup
 def viterbi_decode_batch_windowed(llrs, n_bits: int = None,
                                   window: int = 1024,
                                   overlap: int = DEFAULT_WINDOW_OVERLAP,
-                                  interpret: bool = None):
+                                  interpret: bool = None,
+                                  _decode=None):
     """Sliding-window PARALLEL decode: cut the T-step dependency chain
     into ceil(T/window) overlapping windows and run them as EXTRA BATCH
     LANES of the same kernel.
@@ -282,24 +283,33 @@ def viterbi_decode_batch_windowed(llrs, n_bits: int = None,
     """
     if interpret is None:
         interpret = _interpret_default()
+    if _decode is None:
+        # the production engine; tools/windowed_ber.py injects the
+        # lax.scan engine so the BER study measures exactly this
+        # windowing math without interpret-mode Pallas cost on CPU
+        def _decode(x):
+            return viterbi_decode_batch(x, interpret=interpret)
     llrs = jnp.asarray(llrs, jnp.float32)
     if llrs.ndim == 2:
         llrs = llrs.reshape(llrs.shape[0], -1, 2)
     B, T = llrs.shape[0], llrs.shape[1]
     ext = window + 2 * overlap
     if T <= ext:
-        return viterbi_decode_batch(llrs, n_bits=n_bits,
-                                    interpret=interpret)
+        bits = _decode(llrs)
+        return bits[:, :n_bits] if n_bits is not None else bits
     nwin = -(-T // window)
     starts = np.arange(nwin) * window - overlap
     starts[0] = 0            # window 0 keeps the known-state-0 start
     idx = jnp.asarray(starts)[:, None] + jnp.arange(ext)[None, :]
-    # beyond-frame positions become zero-LLR erasures — the same
-    # "adds no likelihood" padding the full decode uses for T%UNROLL
-    valid = (idx < T).astype(jnp.float32)
+    # out-of-frame positions become zero-LLR erasures — the same
+    # "adds no likelihood" padding the full decode uses for T%UNROLL.
+    # idx >= 0 matters when window < overlap (review r5): without it,
+    # negative warmup positions clip to 0 and feed repeated
+    # full-confidence position-0 LLRs into the warmup instead of
+    # neutral erasures
+    valid = ((idx >= 0) & (idx < T)).astype(jnp.float32)
     wins = llrs[:, jnp.clip(idx, 0, T - 1), :] * valid[None, :, :, None]
-    bits = viterbi_decode_batch(wins.reshape(B * nwin, ext, 2),
-                                interpret=interpret)
+    bits = _decode(wins.reshape(B * nwin, ext, 2))
     bits = bits.reshape(B, nwin, ext)
     keep = (jnp.where(jnp.arange(nwin) == 0, 0, overlap)[:, None]
             + jnp.arange(window)[None, :])             # (nwin, window)
