@@ -643,35 +643,47 @@ def _child_main(run_id):
         if time.time() - t0 > 0.65 * budget:
             raise TimeoutError("skipped: child time budget")
         win, ov = 1024, 96
+        if n_sym * rate.n_dbps <= win + 2 * ov:
+            # too short to window (smoke frames): the decoder would
+            # fall back to the exact path and any "win" would be noise
+            raise TimeoutError("skipped: frame too short to window")
+        # measure at the CURRENT headline width: after the sweep this
+        # is the best exact-decode batch, so windowed x best-B stack
+        Bw = B
+        fw = frames if Bw == 128 else jnp.asarray(
+            np.broadcast_to(frame, (Bw,) + frame.shape).copy())
         dkw = make_decode_k(lambda x: rx.decode_data_batch(
             x, rate, n_sym, n_psdu_bits, viterbi_window=win)[0])
-        acc = int(dkw(frames, jnp.int32(2)))
-        assert acc == _chk_expected(128, 2), (acc, _chk_expected(128, 2))
-        tw1, tw2 = timed_k(dkw, frames, 8), timed_k(dkw, frames, 40)
+        acc = int(dkw(fw, jnp.int32(2)))
+        assert acc == _chk_expected(Bw, 2), (acc, _chk_expected(Bw, 2))
+        tw1, tw2 = timed_k(dkw, fw, 8), timed_k(dkw, fw, 40)
         t_w = (tw2 - tw1) / 32
-        t128 = sweep.get(128, t_tpu)
+        t_ex = sweep.get(Bw, t_tpu)
         # same glitch guard as the sweep: a marginal step implausibly
         # below 1/50 of the exact step is a timing artifact
-        if not t_w > 0.02 * t128:
+        if not t_w > 0.02 * t_ex:
             raise RuntimeError(
                 f"implausible windowed marginal {t_w*1e3:.4f} ms "
-                f"(exact step {t128*1e3:.3f} ms) — timing glitch")
-        rec = {"batch": 128, "window": win, "overlap": ov,
+                f"(exact step {t_ex*1e3:.3f} ms) — timing glitch")
+        rec = {"batch": Bw, "window": win, "overlap": ov,
                "t_step_s": round(t_w, 6),
-               "tpu_sps": round(128 * frame_len / t_w, 1),
-               "vs_exact_step": round(t_w / t128, 3)}
-        note(f"windowed viterbi: {t_w*1e3:.3f} ms/step "
+               "tpu_sps": round(Bw * frame_len / t_w, 1),
+               "vs_exact_step": round(t_w / t_ex, 3)}
+        note(f"windowed viterbi: B={Bw} {t_w*1e3:.3f} ms/step "
              f"({rec['tpu_sps']/1e6:.0f} M sps, "
              f"{rec['vs_exact_step']:.2f}x the exact step)")
         part("windowed", **rec)
         return rec
 
     windowed_captured_t = None
-    if "windowed" in resume:
+    can_window = n_sym * rate.n_dbps > 1024 + 2 * 96
+    if "windowed" in resume and can_window:
         rec_w = resume["windowed"]
         windowed_captured_t = rec_w.get("captured_t", rec_w["t"])
         winrec = reuse(rec_w)
         note("windowed stage resumed from prior window")
+    elif not can_window:
+        winrec = {"skipped": "frame too short to window"}
     elif os.environ.get("ZIRIA_BENCH_WINDOWED", "1") == "0":
         winrec = {"skipped": "ZIRIA_BENCH_WINDOWED=0"}
     else:

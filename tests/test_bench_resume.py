@@ -143,3 +143,43 @@ def test_windowed_headline_never_seeds_exact_width_table(tmp_path):
     # ...and the windowed promotion lives under its own key
     assert out["headline_windowed"]["windowed"] is True
     assert out["headline"]["t_step_s"] == 1e-3   # latest EXACT headline
+
+
+def test_last_good_rejects_derived_and_prefers_stamped_time(tmp_path,
+                                                            monkeypatch):
+    """_last_good (the promotion source when the backend is dark) must
+    never re-accept a promoted result as a fresh capture, and must date
+    captures by the time stamped INSIDE the JSON — file mtimes reset on
+    every rewrite (r5 review: mtime laundering)."""
+    live = tmp_path / "BENCH_LIVE.json"
+    monkeypatch.setattr(bench, "LIVE_PATH", str(live))
+
+    # a derived result (value_source present) is refused
+    live.write_text(json.dumps(
+        {"platform": "tpu", "value": 1.0, "value_source": "promoted"}))
+    assert bench._last_good() is None
+    # a cpu result is refused
+    live.write_text(json.dumps({"platform": "cpu", "value": 1.0}))
+    assert bench._last_good() is None
+    # a fresh capture is accepted, dated by captured_at_unix
+    live.write_text(json.dumps(
+        {"platform": "tpu", "value": 2.0, "captured_at_unix": 123.0}))
+    lg = bench._last_good()
+    assert lg["captured_unix_mtime"] == 123.0
+    # legacy capture without the stamp falls back to the file mtime
+    live.write_text(json.dumps({"platform": "tpu", "value": 3.0}))
+    lg = bench._last_good()
+    assert abs(lg["captured_unix_mtime"] - os.path.getmtime(live)) < 1
+
+
+def test_pinned_baseline_reader(tmp_path, monkeypatch):
+    base = tmp_path / "BASELINE.json"
+    monkeypatch.setattr(bench, "BASELINE_PATH", str(base))
+    assert bench._pinned_baseline() is None          # missing file
+    base.write_text(json.dumps({"pinned_baseline": {"sps": 0}}))
+    assert bench._pinned_baseline() is None          # zero = unset
+    base.write_text(json.dumps(
+        {"pinned_baseline": {"sps": 6401460.9,
+                             "pinned_at": "2026-07-31"}}))
+    pin = bench._pinned_baseline()
+    assert pin["sps"] == 6401460.9
