@@ -94,3 +94,27 @@ def test_window_boundary_alignment(n):
     got = np.asarray(viterbi_pallas.viterbi_decode_batch_windowed(
         llr[None], window=256, overlap=64))
     np.testing.assert_array_equal(got[0], msg)
+
+
+def test_fuzz_random_geometry_matches_exact():
+    """Property check across random (T, window, overlap) geometries —
+    boundary/stitch errors tend to hide at odd alignments. Uses the
+    lax.scan engine through the production windowing math (_decode
+    hook) so 12 configurations stay fast; Pallas==scan is pinned by
+    the other tests in this file."""
+    import jax
+
+    def eng(x):
+        return jax.vmap(viterbi.viterbi_decode)(x)
+
+    rng = np.random.default_rng(77)
+    for _ in range(12):
+        n = int(rng.integers(300, 2600))
+        window = int(rng.integers(48, 700))
+        overlap = int(rng.integers(16, 160))
+        msg, llr = _encoded_llrs(rng, n, snr=2.5)
+        got = np.asarray(viterbi_pallas.viterbi_decode_batch_windowed(
+            llr[None], window=window, overlap=overlap, _decode=eng))
+        want = np.asarray(eng(llr[None]))
+        np.testing.assert_array_equal(
+            got, want, err_msg=f"n={n} window={window} overlap={overlap}")
