@@ -896,6 +896,69 @@ def _child_main(run_id):
             note(f"fxp stage failed: {e!r}")
             fxp_ev = {"error": repr(e)}
 
+    # TX chain on-chip (r5; BASELINE config #3): the batched transmit
+    # encode (scramble + conv + interleave + modulate + matmul-IFFT +
+    # preamble/SIGNAL assembly) with the same marginal-step method.
+    # All-parallel work — the counterpoint to the trellis-bound RX.
+    def _tx_stage():
+        if time.time() - t0 > 0.88 * budget:
+            raise TimeoutError("skipped: child time budget")
+        from ziria_tpu.phy.wifi import tx as txm
+        Bt = 128
+        bits = jnp.asarray(np.broadcast_to(
+            np.asarray(want, np.uint8), (Bt, want.size)).copy())
+        enc = jax.jit(jax.vmap(
+            lambda b: txm.encode_frame_bits(b, rate)))
+        got0 = np.asarray(enc(bits))
+        # correctness gate: every encoded row equals the committed
+        # reference frame (the same PSDU _setup encoded)
+        assert np.allclose(got0[0], frame, atol=1e-4) \
+            and np.allclose(got0[-1], frame, atol=1e-4)
+
+        @jax.jit
+        def tx_k(bb, k):
+            def body(_i, carry):
+                s, acc = carry
+                out = jax.vmap(
+                    lambda b: txm.encode_frame_bits(b, rate)
+                )(jnp.bitwise_xor(bb, s))
+                # runtime-zero, data-dependent feedback (cf. the RX
+                # loop): the next iteration's input depends on this
+                # one's output, so the body cannot be hoisted
+                s2 = (out[0, 0, 0] * 1e-30).astype(jnp.uint8)
+                return (jnp.broadcast_to(s2, bb.shape),
+                        acc + out.sum() * 1e-30)
+            z0 = jnp.zeros_like(bits)
+            return jax.lax.fori_loop(
+                0, k, body, (z0, jnp.float32(0)))[1]
+
+        tt1, tt2 = timed_k(tx_k, bits, 8), timed_k(tx_k, bits, 40)
+        t_tx = (tt2 - tt1) / 32
+        # plausibility (cf. the fxp stage's guard): the marginal step
+        # can't be negative or far below the K=40 run's average step —
+        # that's scheduler noise on the K-spread, not physics, and it
+        # must not persist as a resumable record
+        if not t_tx > 0.02 * (tt2 / 40):
+            raise RuntimeError(
+                f"implausible tx marginal {t_tx*1e3:.4f} ms "
+                f"(K=40 avg {tt2/40*1e3:.3f} ms) — timing glitch")
+        rec = {"batch": Bt, "t_step_s": round(t_tx, 6),
+               "tx_sps": round(Bt * frame_len / t_tx, 1)}
+        note(f"tx chain: {t_tx*1e3:.3f} ms/step "
+             f"({rec['tx_sps']/1e6:.0f} M samples/s generated)")
+        part("tx_chain", **rec)
+        return rec
+
+    if "tx_chain" in resume:
+        tx_ev = reuse(resume["tx_chain"])
+        note("tx chain resumed from prior window")
+    else:
+        try:
+            tx_ev = _tx_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"tx stage failed: {e!r}")
+            tx_ev = {"error": repr(e)}
+
     def _percall_fence_stage():
         # per-call diagnostic (tunnel-dispatch-bound upper bound on
         # latency) — always taken at the base batch of 128, which may
@@ -957,6 +1020,7 @@ def _child_main(run_id):
         "decompose": decomp,
         "framebatch": fb,
         "fxp_interior": fxp_ev,
+        "tx_chain": tx_ev,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
         "resumed_stages": sorted(set(resumed_stages)),
     }
@@ -1377,7 +1441,7 @@ def main():
                   "fence_audit_bur_over_copy",
                   "timing_method", "pallas_mosaic", "roofline",
                   "batch_sweep", "windowed", "decompose", "framebatch",
-                  "fxp_interior", "frame_bytes", "partial",
+                  "fxp_interior", "tx_chain", "frame_bytes", "partial",
                   "resumed_stages"):
             if k in child:
                 result[k] = child.get(k)
