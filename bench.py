@@ -959,6 +959,50 @@ def _child_main(run_id):
             note(f"tx stage failed: {e!r}")
             tx_ev = {"error": repr(e)}
 
+    # Micro configs on-chip (r5; BASELINE configs #1/#2): the FIR
+    # pipeline and the registered 64-pt FFT-block pipeline, each at
+    # the vectorizer's chosen width, timed with the calibration tool's
+    # own device-loop method (imported, not re-implemented, so the two
+    # cannot drift). Two independently resumable stages: a window that
+    # dies between them keeps the finished half.
+    def _micro_config(prog_name):
+        if time.time() - t0 > 0.92 * budget:
+            raise TimeoutError("skipped: child time budget")
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "calibrate_vect", os.path.join(REPO, "tools",
+                                           "calibrate_vect.py"))
+        cv = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cv)
+
+        from ziria_tpu.core.vectorize import vectorize
+        from ziria_tpu.runtime.cli import PROGS
+        comp = PROGS[prog_name]()
+        W = vectorize(comp).segments[0].width
+        shape = {"fir": (), "fft64": (2,)}[prog_name]  # complex pairs
+        # _time_width clamps the marginal >= 1e-9 (no glitch records)
+        t_s, take = cv._time_width(comp, W, item_shape=shape)
+        ev = {"config": prog_name, "width": W,
+              "s_per_step": round(t_s, 9),
+              "items_per_s": round(take / t_s, 1)}
+        note(f"micro: {prog_name} W={W} "
+             f"{take / t_s / 1e6:.2f} M items/s")
+        part(f"micro_{prog_name}", **ev)
+        return ev
+
+    micro_ev = {}
+    for prog_name in ("fir", "fft64"):
+        key = f"micro_{prog_name}"
+        if key in resume:
+            micro_ev[prog_name] = reuse(resume[key])
+            note(f"micro {prog_name} resumed from prior window")
+        else:
+            try:
+                micro_ev[prog_name] = _micro_config(prog_name)
+            except Exception as e:      # evidence stage: never fatal
+                note(f"micro {prog_name} failed: {e!r}")
+                micro_ev[prog_name] = {"error": repr(e)}
+
     def _percall_fence_stage():
         # per-call diagnostic (tunnel-dispatch-bound upper bound on
         # latency) — always taken at the base batch of 128, which may
@@ -1021,6 +1065,7 @@ def _child_main(run_id):
         "framebatch": fb,
         "fxp_interior": fxp_ev,
         "tx_chain": tx_ev,
+        "micro": micro_ev,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
         "resumed_stages": sorted(set(resumed_stages)),
     }
@@ -1441,8 +1486,8 @@ def main():
                   "fence_audit_bur_over_copy",
                   "timing_method", "pallas_mosaic", "roofline",
                   "batch_sweep", "windowed", "decompose", "framebatch",
-                  "fxp_interior", "tx_chain", "frame_bytes", "partial",
-                  "resumed_stages"):
+                  "fxp_interior", "tx_chain", "micro", "frame_bytes",
+                  "partial", "resumed_stages"):
             if k in child:
                 result[k] = child.get(k)
         if err:

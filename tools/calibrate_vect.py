@@ -60,10 +60,11 @@ def _fence(x):
     np.asarray(x.ravel()[:1])
 
 
-def _time_width(comp, W: int):
+def _time_width(comp, W: int, item_shape: tuple = ()):
     """(marginal seconds per fused step at width W, items per step) —
     timed via a device-side chain of K steps (cancels the tunnel
-    round-trip)."""
+    round-trip). ``item_shape`` is the per-item trailing shape (() for
+    scalar streams, (2,) for complex16 pair streams)."""
     import jax
     import jax.numpy as jnp
 
@@ -71,8 +72,8 @@ def _time_width(comp, W: int):
 
     lowered = lower(comp, width=W)
     take = lowered.take
-    xs = jnp.asarray(
-        np.random.default_rng(0).normal(size=take).astype(np.float32))
+    xs = jnp.asarray(np.random.default_rng(0).normal(
+        size=(take,) + tuple(item_shape)).astype(np.float32))
 
     @jax.jit
     def step_k(x0, k):
