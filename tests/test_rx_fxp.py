@@ -264,3 +264,21 @@ def test_fxp_llrs_track_float_llrs():
     big = np.abs(dep_f) > 0.05 * np.abs(dep_f).max()
     agree = (np.sign(dep_f[big]) == np.sign(dep_q[big])).mean()
     assert agree > 0.999
+
+
+def test_batch_fxp_windowed_matches_exact():
+    """viterbi_window on the integer batch path: same PSDU as the
+    exact fxp decode on a long frame that genuinely windows (54 Mbps,
+    200 bytes -> ~1650 trellis steps at window=512), preserving the
+    integer front end untouched."""
+    rate, psdu, frame, n_sym = _clean_case(54, 200, seed=33)
+    noisy = frame + np.random.default_rng(34).normal(
+        scale=0.03, size=frame.shape).astype(np.float32)
+    fq = np.asarray(rx_fxp.quantize_frame(noisy))
+    batch = jnp.asarray(np.stack([fq, fq]))
+    exact, _ = rx_fxp.decode_data_batch_fxp(batch, rate, n_sym, 8 * 200)
+    win, _ = rx_fxp.decode_data_batch_fxp(batch, rate, n_sym, 8 * 200,
+                                          viterbi_window=512)
+    np.testing.assert_array_equal(np.asarray(win), np.asarray(exact))
+    np.testing.assert_array_equal(np.asarray(win[0]),
+                                  np.asarray(bytes_to_bits(psdu)))

@@ -249,6 +249,19 @@ def viterbi_decode_batch(llrs, n_bits: int = None, interpret: bool = None):
 DEFAULT_WINDOW_OVERLAP = 96   # ~14 constraint lengths of warmup
 
 
+def viterbi_decode_batch_opt(llrs, n_bits: int = None,
+                             window: int = None,
+                             interpret: bool = None):
+    """ONE dispatch for the batch decode's window option (review r5:
+    the if/else was copied at every call site): ``window=None/0`` runs
+    the exact kernel, ``window=N`` the sliding-window parallel decode
+    below."""
+    if window:
+        return viterbi_decode_batch_windowed(
+            llrs, n_bits=n_bits, window=window, interpret=interpret)
+    return viterbi_decode_batch(llrs, n_bits=n_bits, interpret=interpret)
+
+
 def viterbi_decode_batch_windowed(llrs, n_bits: int = None,
                                   window: int = 1024,
                                   overlap: int = DEFAULT_WINDOW_OVERLAP,

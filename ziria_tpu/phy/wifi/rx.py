@@ -136,13 +136,9 @@ def decode_data_batch(frames, rate: RateParams, n_sym: int,
     (including the reference's SORA brick) makes, bit-identical to the
     exact decode at operating SNR (tests/test_viterbi_windowed.py)."""
     dep = jax.vmap(lambda f: _decode_front(f, rate, n_sym))(frames)
-    if viterbi_window:
-        bits = viterbi_pallas.viterbi_decode_batch_windowed(
-            dep, n_bits=n_sym * rate.n_dbps, window=viterbi_window,
-            interpret=interpret)
-    else:
-        bits = viterbi_pallas.viterbi_decode_batch(
-            dep, n_bits=n_sym * rate.n_dbps, interpret=interpret)
+    bits = viterbi_pallas.viterbi_decode_batch_opt(
+        dep, n_bits=n_sym * rate.n_dbps, window=viterbi_window,
+        interpret=interpret)
     return jax.vmap(lambda b: _decode_back(b, n_psdu_bits))(bits)
 
 

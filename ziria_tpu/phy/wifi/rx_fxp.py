@@ -181,15 +181,23 @@ def decode_data_bucketed_fxp(frame_q, rate: RateParams,
 
 
 def decode_data_batch_fxp(frames_q, rate: RateParams, n_sym: int,
-                          n_psdu_bits: int, interpret: bool = None):
+                          n_psdu_bits: int, interpret: bool = None,
+                          viterbi_window: int = None):
     """Batched integer decode: (B, frame_len, 2) int -> ((B, n), (B, 16)).
     Same lane layout as rx.decode_data_batch: vmapped integer front
-    end, Pallas Viterbi across the batch."""
+    end, Pallas Viterbi across the batch.
+
+    ``viterbi_window`` opts into the sliding-window parallel Viterbi,
+    exactly as on the float path. The integer LLRs reaching the kernel
+    are unchanged, so the cross-backend bit-identity contract holds
+    per-window too; what changes is the (measured-zero-BER) windowed
+    approximation vs the exact trellis — see docs/windowed_viterbi.md.
+    """
     dep = jax.vmap(
         lambda f: decode_front_fxp(f, rate, n_sym))(frames_q)
-    bits = viterbi_pallas.viterbi_decode_batch(
+    bits = viterbi_pallas.viterbi_decode_batch_opt(
         dep.astype(jnp.float32), n_bits=n_sym * rate.n_dbps,
-        interpret=interpret)
+        window=viterbi_window, interpret=interpret)
 
     def back(b):
         seed = scramble.recover_seed(b[:7])
