@@ -1,0 +1,32 @@
+"""Driver contract of bench.py: ONE parseable JSON line on stdout with
+the keys the round harness records (metric/value/unit/vs_baseline),
+whatever the backend's state. Runs the real parent with --no-tpu (the
+numpy baseline path + last_good promotion logic) in a subprocess, like
+the driver does."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_no_tpu_emits_driver_contract():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--no-tpu"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
+    j = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in j, f"missing driver key {key}"
+    assert j["metric"] == "80211a_rx_samples_per_sec_per_chip"
+    assert j["value"] > 0 and j["vs_baseline"] > 0
+    # the pinned denominator is committed; every published multiple
+    # divides by it
+    assert j.get("pinned_baseline_sps") == 6401460.9
+    # whatever value is published, it is either a real capture
+    # (platform stamped) or the clearly-labelled baseline fallback
+    assert j.get("platform") or j.get("tpu", "").startswith("unavail")
