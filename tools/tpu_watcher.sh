@@ -100,9 +100,9 @@ print('probe ok:', d.platform, d.device_kind)
     harvest tools/viterbi_batch_sweep.py /root/repo/VITERBI_SWEEP.json 900
     # 3) cheap resume pass merging everything the window landed
     if run_bench || [ "$bench_ok" -eq 0 ]; then
-      echo "[watcher] CHAIN DONE $(date -u +%H:%M:%S); sleeping 3h" >> "$LOG"
+      echo "[watcher] CHAIN DONE $(date -u +%H:%M:%S); re-harvest in 1h" >> "$LOG"
       rm -f /tmp/tpu_busy
-      sleep 10800
+      sleep 3600
       continue
     fi
     rm -f /tmp/tpu_busy
