@@ -25,8 +25,13 @@ def test_bench_no_tpu_emits_driver_contract():
     assert j["metric"] == "80211a_rx_samples_per_sec_per_chip"
     assert j["value"] > 0 and j["vs_baseline"] > 0
     # the pinned denominator is committed; every published multiple
-    # divides by it
-    assert j.get("pinned_baseline_sps") == 6401460.9
+    # divides by it. The contract is "a pinned denominator is used",
+    # not a specific value — compare against BASELINE.json so a
+    # legitimate re-pin (bench.py --pin-baseline) does not break the
+    # suite (ADVICE r5 #5)
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        pinned = json.load(f)["pinned_baseline"]["sps"]
+    assert j.get("pinned_baseline_sps") == pinned
     # whatever value is published, it is either a real capture
     # (platform stamped) or the clearly-labelled baseline fallback
     assert j.get("platform") or j.get("tpu", "").startswith("unavail")

@@ -68,6 +68,8 @@ def _simulate_axon_box(monkeypatch, tmp_path):
     monkeypatch.setattr(cli, "_jax_platforms_pinned", lambda: False)
     monkeypatch.setattr(cli, "TPU_BUSY_FLAG",
                         str(tmp_path / "no_such_flag"))
+    # a success cached by an earlier test must not leak in
+    monkeypatch.setattr(cli, "_probe_ok_t", 0.0)
 
 
 def test_dead_backend_returns_rc2(monkeypatch, capsys, tmp_path):
@@ -126,3 +128,72 @@ def test_inprocess_pin_skips_probe(monkeypatch):
                         lambda *a, **k: called.append(1) or True)
     assert cli._fastfail_dead_backend(_args()) is None
     assert not called
+
+
+def test_probe_holds_busy_flag_and_releases(monkeypatch, tmp_path):
+    # TOCTOU fix (ADVICE r5 #2): the probe runs UNDER an O_EXCL claim
+    # of the busy flag, so a watcher starting mid-probe waits instead
+    # of attaching a second axon client; the claim is released after
+    _simulate_axon_box(monkeypatch, tmp_path)
+    flag = tmp_path / "busy"
+    monkeypatch.setattr(cli, "TPU_BUSY_FLAG", str(flag))
+    seen = []
+    monkeypatch.setattr(
+        cli, "_backend_probe_failed",
+        lambda *a, **k: seen.append(
+            flag.exists() and "cli probe" in flag.read_text()) or False)
+    assert cli._fastfail_dead_backend(_args()) is None
+    assert seen == [True]
+    assert not flag.exists()
+
+
+def test_stale_flag_taken_over_for_probe(monkeypatch, tmp_path):
+    # a leaked flag (older than BUSY_STALE_S) must not block forever:
+    # the claim takes it over, probes, and releases
+    import os
+    _simulate_axon_box(monkeypatch, tmp_path)
+    flag = tmp_path / "busy"
+    flag.write_text("dead holder\n")
+    old = time.time() - cli.BUSY_STALE_S - 60
+    os.utime(flag, (old, old))
+    monkeypatch.setattr(cli, "TPU_BUSY_FLAG", str(flag))
+    probed = []
+    monkeypatch.setattr(cli, "_backend_probe_failed",
+                        lambda *a, **k: probed.append(1) or False)
+    assert cli._fastfail_dead_backend(_args()) is None
+    assert probed and not flag.exists()
+
+
+def test_successful_probe_cached(monkeypatch, tmp_path):
+    # the healthy path pays ONE probe subprocess, not one per
+    # invocation: a recent success short-circuits the next call
+    _simulate_axon_box(monkeypatch, tmp_path)
+    probed = []
+    monkeypatch.setattr(cli, "_backend_probe_failed",
+                        lambda *a, **k: probed.append(1) or False)
+    assert cli._fastfail_dead_backend(_args()) is None
+    assert cli._fastfail_dead_backend(_args()) is None
+    assert len(probed) == 1
+
+
+def test_failed_probe_not_cached(monkeypatch, tmp_path):
+    # only SUCCESS is cached: a dead tunnel is re-probed next time
+    _simulate_axon_box(monkeypatch, tmp_path)
+    results = [True, False]
+    probed = []
+    monkeypatch.setattr(
+        cli, "_backend_probe_failed",
+        lambda *a, **k: probed.append(1) or results[len(probed) - 1])
+    assert cli._fastfail_dead_backend(_args()) == 2
+    assert cli._fastfail_dead_backend(_args()) is None
+    assert len(probed) == 2
+
+
+def test_claim_busy_flag_lost_race(monkeypatch, tmp_path):
+    # a fresh flag appearing between the staleness check and the claim
+    # is a live client: report held (None from _claim_busy_flag)
+    flag = tmp_path / "busy"
+    flag.write_text("watcher pid 9\n")
+    monkeypatch.setattr(cli, "TPU_BUSY_FLAG", str(flag))
+    assert cli._claim_busy_flag() is None
+    assert flag.read_text() == "watcher pid 9\n"   # untouched

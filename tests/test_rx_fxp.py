@@ -164,10 +164,13 @@ def test_fxp_decodes_impaired_frame_like_float():
         np.testing.assert_array_equal(np.asarray(got), want)
 
 
+@pytest.mark.slow
 def test_fxp_bit_identical_across_jit_and_vmap_width():
     """The contract the module exists for: same quantized input ->
     bit-identical LLRs and bits, eager vs jit, batch of 1 vs batch of
-    4, and batched rows vs per-frame runs."""
+    4, and batched rows vs per-frame runs. (tier-2: ~30s of
+    per-geometry compiles; the clean/impaired e2e tests above keep
+    the fxp interior covered in the tier-1 budget run)"""
     rate, psdu, frame, n_sym = _clean_case(24, 80, seed=30)
     noisy = frame + np.random.default_rng(31).normal(
         scale=0.05, size=frame.shape).astype(np.float32)
@@ -216,12 +219,14 @@ def test_receive_fxp_switch():
             np.asarray(bytes_to_bits(np.asarray(psdu, np.uint8))))
 
 
+@pytest.mark.slow
 def test_fxp_ber_matches_float_at_operating_point():
     """Statistical agreement (the BER-waterfall suite's discipline
     applied to the integer interior): over a batch of AWGN frames at
     the 54 Mbps operating SNR, the fxp path's bit errors stay within
     a small absolute gap of the float path's (quantization loss only,
-    no systematic degradation)."""
+    no systematic degradation). (tier-2: a ~35s 16-frame statistical
+    study — the point-wise fxp e2e tests above stay in tier-1)"""
     mbps, snr_db, n_frames, n_bytes = 54, 26.0, 16, 100
     rate = RATES[mbps]
     n_sym = n_symbols(n_bytes, rate)
@@ -266,11 +271,14 @@ def test_fxp_llrs_track_float_llrs():
     assert agree > 0.999
 
 
+@pytest.mark.slow
 def test_batch_fxp_windowed_matches_exact():
     """viterbi_window on the integer batch path: same PSDU as the
     exact fxp decode on a long frame that genuinely windows (54 Mbps,
     200 bytes -> ~1650 trellis steps at window=512), preserving the
-    integer front end untouched."""
+    integer front end untouched. (tier-2: ~55s — interpret-mode
+    Pallas over a long trellis twice; the float windowed guard plus
+    the fxp e2e tests cover the composition in tier-1)"""
     rate, psdu, frame, n_sym = _clean_case(54, 200, seed=33)
     noisy = frame + np.random.default_rng(34).normal(
         scale=0.03, size=frame.shape).astype(np.float32)

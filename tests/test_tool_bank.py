@@ -68,3 +68,32 @@ def test_corrupt_file_is_empty(tmp_path, monkeypatch):
     with open(os.path.join(str(tmp_path), "b.json"), "w") as f:
         f.write("not json")
     assert bank.load_bank("b", "tpu") == {}
+
+
+def test_concurrent_writers_lose_no_entries(tmp_path, monkeypatch):
+    # ADVICE r5 #4: save_entry's read-modify-write runs under the
+    # bank's lock file, so concurrent bankers serialize — every
+    # writer's entries survive. Without the lock this interleaving
+    # (read, read, write, write) loses entries.
+    from concurrent.futures import ThreadPoolExecutor
+
+    bank = _bank(tmp_path, monkeypatch)
+
+    def writer(w):
+        for i in range(25):
+            bank.save_entry("b", "tpu", f"w{w}_k{i}", {"v": i})
+
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        list(ex.map(writer, range(4)))
+    out = bank.load_bank("b", "tpu")
+    assert len(out) == 4 * 25
+    assert {f"w{w}_k{i}" for w in range(4) for i in range(25)} \
+        == set(out)
+
+
+def test_lock_file_does_not_pollute_bank(tmp_path, monkeypatch):
+    # the sidecar .lock must never be read back as a bank
+    bank = _bank(tmp_path, monkeypatch)
+    bank.save_entry("b", "tpu", "k", {"v": 1})
+    assert os.path.exists(os.path.join(str(tmp_path), "b.json.lock"))
+    assert "k" in bank.load_bank("b", "tpu")

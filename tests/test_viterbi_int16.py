@@ -1,0 +1,235 @@
+"""int16 saturating-metric Viterbi (docs/quantized_viterbi.md).
+
+The quantized kernel's contract has three layers, each pinned here:
+
+1. the int16 Pallas ACS kernel decodes bit-exactly what the f32
+   ``lax.scan`` oracle decodes on the SAME quantized inputs (integer
+   branch metrics are exact in both arithmetics; the per-block renorm
+   + saturation only ever clips floored states) — across batch sizes
+   and frame lengths including the bench shape;
+2. the int16 scan oracle (``viterbi_decode_int16``) agrees with both,
+   so the quantized semantics have a readable reference;
+3. on RAW noisy inputs (where quantization rounding may legitimately
+   flip a decision) the end-to-end int16 decode stays within the same
+   bounded-BER envelope as the windowed decode's guard
+   (tests/test_windowed_ber_guard.py).
+"""
+
+import importlib.util
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from ziria_tpu.ops import viterbi, viterbi_pallas
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "windowed_ber", os.path.join(_REPO, "tools", "windowed_ber.py"))
+_wb = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_wb)
+_frames = _wb.make_coded_frames     # ONE signal recipe with the study
+
+BENCH_T = 8208      # 1000-byte 54 Mbps DATA trellis (bench shape)
+
+
+def _oracle_f32(qllrs):
+    """The f32 lax.scan decode of already-quantized integer inputs —
+    the oracle the acceptance contract names. Integer-valued branch
+    metrics are exact in f32 (|path metric| < 2^24 for any T here),
+    so this is the unquantized-arithmetic ground truth."""
+    return np.asarray(jax.vmap(viterbi.viterbi_decode)(
+        np.asarray(qllrs, np.float32)))
+
+
+@pytest.mark.parametrize("B", [8, 128])
+@pytest.mark.parametrize("T", [256, 1000])
+def test_i16_kernel_bit_exact_vs_f32_scan_oracle(B, T):
+    rng = np.random.default_rng(B * 10000 + T)
+    _msgs, llrs = _frames(rng, B, T, amp=1.2)
+    q, _scale = viterbi.quantize_llrs(llrs)
+    want = _oracle_f32(q)
+    got = np.asarray(viterbi_pallas.viterbi_decode_batch(
+        llrs, metric_dtype="int16"))
+    np.testing.assert_array_equal(got, want)
+    # the int16 scan oracle sits between the two: same bits again
+    scan_i16 = np.asarray(jax.vmap(viterbi.viterbi_decode_int16)(q))
+    np.testing.assert_array_equal(scan_i16, want)
+
+
+@pytest.mark.slow
+def test_i16_kernel_bit_exact_bench_shape():
+    # tier-2: ~30s of interpret-mode Pallas at the full 8208-step
+    # trellis — the {256, 1000} matrix above covers the kernel logic
+    # in tier-1; this pins the headline geometry for chip windows
+    # the headline geometry: 128 lanes x the 8208-step DATA trellis.
+    # The interpret-mode kernel walks one 128-lane tile either way, so
+    # B=8 (padded to the tile) and B=128 both ride this check: decode
+    # B=128, then re-decode the first 8 lanes as their own batch
+    # (per-frame quantization scales make the two decodes of a lane
+    # identical by construction — this pins it).
+    rng = np.random.default_rng(2026)
+    _msgs, llrs = _frames(rng, 128, BENCH_T, amp=1.2)
+    q, _scale = viterbi.quantize_llrs(llrs)
+    got = np.asarray(viterbi_pallas.viterbi_decode_batch(
+        llrs, metric_dtype="int16"))
+    np.testing.assert_array_equal(got, _oracle_f32(q))
+
+    sub = llrs[:8]
+    q8, _ = viterbi.quantize_llrs(sub)
+    got8 = np.asarray(viterbi_pallas.viterbi_decode_batch(
+        sub, metric_dtype="int16"))
+    np.testing.assert_array_equal(got8, _oracle_f32(q8))
+
+
+def _scan_i16(x):
+    """The quantized decode's scan engine (quantize + int16 oracle) —
+    the same semantics the Pallas kernel computes (pinned by the
+    kernel-parity tests above), without interpret-mode kernel cost."""
+    q, _ = viterbi.quantize_llrs(x)
+    return np.asarray(jax.vmap(viterbi.viterbi_decode_int16)(q))
+
+
+def test_i16_on_raw_inputs_bounded_ber():
+    # raw noisy floats: rounding at the quantization boundary may
+    # legitimately move individual decisions, but the error RATE must
+    # stay inside the windowed guard's envelope (same form/margins as
+    # tests/test_windowed_ber_guard.py) both at the operating point
+    # and below the waterfall
+    for seed, amp in ((3, 1.2), (7, 0.9)):
+        rng = np.random.default_rng(seed)
+        msgs, llrs = _frames(rng, 4, 2048, amp=amp)
+        f32 = np.asarray(jax.vmap(viterbi.viterbi_decode)(llrs))
+        i16 = _scan_i16(llrs)
+        ber_f = (f32 != msgs).mean()
+        ber_q = (i16 != msgs).mean()
+        assert abs(ber_q - ber_f) < 0.02 * max(ber_f, 1e-9) + 2e-3, \
+            (amp, ber_f, ber_q)
+
+
+def _scan_i16_raw(q):
+    """int16-input scan engine: decode pre-quantized integers as-is
+    (what the production batch decode does with int16 input)."""
+    return np.asarray(jax.vmap(viterbi.viterbi_decode_int16)(
+        np.asarray(q, np.int32)))
+
+
+def test_windowed_i16_matches_full_i16_at_operating_point():
+    # the two knobs compose. The windowed decode quantizes PER FRAME
+    # **before** cutting windows, so every window slices the exact
+    # integers the full-frame decode consumes, and at the operating
+    # amplitude the windowed int16 decode reproduces the full int16
+    # decode bit-for-bit (the same survivor-merge argument as the f32
+    # windowed guard). Against the f32 decode only the BER envelope is
+    # promised — quantization rounding may legitimately move near-tie
+    # decisions. (scan engines via _decode injection, the windowed-
+    # guard idiom — the windowing math is what's under test, not the
+    # kernel; metric_dtype="int16" makes the windowed path hand the
+    # injected engine int16 windows)
+    rng = np.random.default_rng(5)
+    msgs, llrs = _frames(rng, 4, 2048, amp=1.2)
+    full = _scan_i16(llrs)
+    win = np.asarray(viterbi_pallas.viterbi_decode_batch_windowed(
+        llrs, window=512, metric_dtype="int16", _decode=_scan_i16_raw))
+    np.testing.assert_array_equal(win, full)
+    assert (full != msgs).mean() < 0.05     # an OPERATING decoder
+    f32 = np.asarray(viterbi_pallas.viterbi_decode_batch_windowed(
+        llrs, window=512,
+        _decode=lambda x: jax.vmap(viterbi.viterbi_decode)(x)))
+    assert abs((win != msgs).mean() - (f32 != msgs).mean()) \
+        < 0.02 * max((f32 != msgs).mean(), 1e-9) + 2e-3
+
+
+def test_quantize_llrs_contract():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 64, 2)).astype(np.float32) * 7.5
+    q, scale = viterbi.quantize_llrs(x)
+    q, scale = np.asarray(q), np.asarray(scale)
+    assert q.dtype == np.int16
+    assert scale.shape == (4, 1, 1)     # one scale PER FRAME
+    # every lane's own peak maps to QMAX — no lane's quantization
+    # depends on its batch-mates (the receive_many == receive
+    # bit-identity hinges on this)
+    np.testing.assert_array_equal(
+        np.abs(q).max(axis=(1, 2)), [viterbi.QUANT_MAX] * 4)
+    np.testing.assert_array_equal(
+        q, np.clip(np.round(x * scale),
+                   -viterbi.QUANT_MAX, viterbi.QUANT_MAX))
+    # a single frame quantizes identically to its batched self
+    q0, s0 = viterbi.quantize_llrs(x[0])
+    assert np.asarray(s0).shape == ()
+    np.testing.assert_array_equal(np.asarray(q0), q[0])
+
+
+def test_saturation_touches_only_floored_states():
+    # adversarial drive: noise-free max-amplitude inputs at exactly
+    # +-QUANT_MAX (quantization scale = 1, rounding = identity) open
+    # the widest possible metric spread — losing states fall 2*127 per
+    # step until they pin at the int16 rail — while the surviving path
+    # (max renormed to 0 each block) must be untouched: decode still
+    # matches the f32 oracle on the same quantized inputs. T matches
+    # the [1000-8] parity case so the kernel compile is reused.
+    rng = np.random.default_rng(9)
+    msgs, llrs = _frames(rng, 8, 1000, amp=1.0)
+    llrs = np.sign(llrs - np.float32(1e-7)) * viterbi.QUANT_MAX
+    q, _ = viterbi.quantize_llrs(llrs)
+    np.testing.assert_array_equal(np.asarray(q), llrs)  # scale == 1
+    got = np.asarray(viterbi_pallas.viterbi_decode_batch(
+        llrs, metric_dtype="int16"))
+    np.testing.assert_array_equal(got, _oracle_f32(q))
+
+
+def test_metric_dtype_validation():
+    x = np.zeros((2, 64, 2), np.float32)
+    with pytest.raises(ValueError, match="metric_dtype"):
+        viterbi.viterbi_decode(x[0], metric_dtype="int8")
+    with pytest.raises(ValueError, match="metric_dtype"):
+        viterbi_pallas.viterbi_decode_batch(x, metric_dtype="bfloat16")
+    # None and the explicit default are the same legal surface
+    a = np.asarray(viterbi.viterbi_decode(x[0], n_bits=8))
+    b = np.asarray(viterbi.viterbi_decode(x[0], n_bits=8,
+                                          metric_dtype="float32"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_cli_choices_mirror_metric_dtypes():
+    # runtime/cli.py hardcodes the --viterbi-metric choices so --help
+    # stays import-light; this pins them to the ops-layer registry
+    from ziria_tpu.runtime.cli import build_parser
+    for a in build_parser()._actions:
+        if a.dest == "viterbi_metric":
+            assert tuple(a.choices) == viterbi.METRIC_DTYPES
+            return
+    raise AssertionError("--viterbi-metric flag missing")
+
+
+def test_env_mode_reaches_staged_viterbi_soft(monkeypatch):
+    # ZIRIA_VITERBI_METRIC routes every STAGED viterbi_soft through
+    # the quantized decode, and the mode is part of the backend's
+    # compile cache key — flipping the env after tracing must RE-trace
+    # (ADVICE r5 #1), observable here through viterbi_mode()
+    import jax.numpy as jnp
+
+    from ziria_tpu.frontend import externals
+
+    monkeypatch.delenv("ZIRIA_VITERBI_WINDOW", raising=False)
+    monkeypatch.delenv("ZIRIA_VITERBI_METRIC", raising=False)
+    assert externals.viterbi_mode() == (0, "float32")
+    monkeypatch.setenv("ZIRIA_VITERBI_METRIC", "int16")
+    monkeypatch.setenv("ZIRIA_VITERBI_WINDOW", "512")
+    assert externals.viterbi_mode() == (512, "int16")
+    monkeypatch.setenv("ZIRIA_VITERBI_METRIC", "int8")
+    with pytest.raises(ValueError, match="ZIRIA_VITERBI_METRIC"):
+        externals.viterbi_mode()
+    monkeypatch.setenv("ZIRIA_VITERBI_METRIC", "int16")
+
+    # staged decode agrees with the f32 staged decode at operating SNR
+    vs = externals.EXTERNALS["viterbi_soft"]
+    rng = np.random.default_rng(4)
+    n = 600
+    msgs, frames = _frames(rng, 1, n, amp=3.0)
+    llrs = frames[0].reshape(-1)
+    got = np.asarray(jax.jit(
+        lambda x: vs(x, n, n))(jnp.asarray(llrs)))
+    np.testing.assert_array_equal(got[:n], msgs[0])

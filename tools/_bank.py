@@ -11,9 +11,15 @@ chained-resume hazard bench.py's ``captured_t`` guards against).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
+
+try:
+    import fcntl
+except ImportError:                   # pragma: no cover - non-POSIX
+    fcntl = None
 
 SCRATCH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "..", ".bench_scratch")
@@ -22,6 +28,27 @@ MAX_AGE_S = 6 * 3600.0
 
 def _path(name: str) -> str:
     return os.path.join(SCRATCH, name + ".json")
+
+
+@contextlib.contextmanager
+def _bank_lock(name: str):
+    """Serialize the read-modify-write of one bank file across
+    concurrent bankers (ADVICE r5 #4: two tools banking at once could
+    lose each other's entries — previously mitigated only by the
+    /tmp/tpu_busy serialization convention). An flock on a sidecar
+    .lock file: advisory, crash-safe (the OS releases with the fd),
+    so no stale-lock aging is needed."""
+    if fcntl is None:                 # pragma: no cover - non-POSIX
+        yield
+        return
+    fd = os.open(_path(name) + ".lock",
+                 os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
 
 
 def load_bank(name: str, platform: str, match: dict = None,
@@ -47,23 +74,27 @@ def load_bank(name: str, platform: str, match: dict = None,
 def save_entry(name: str, platform: str, key: str, entry: dict,
                match: dict = None) -> None:
     """Bank one finished unit (stamped with its capture time),
-    atomically. A platform/match mismatch discards the old bank."""
+    atomically. A platform/match mismatch discards the old bank.
+    The whole read-modify-write runs under the bank's lock file so
+    concurrent bankers serialize instead of losing entries."""
     os.makedirs(SCRATCH, exist_ok=True)
-    try:
-        with open(_path(name)) as f:
-            saved = json.load(f)
-    except (OSError, json.JSONDecodeError):
-        saved = {}
-    if saved.get("platform") != platform or any(
-            saved.get(k) != v for k, v in (match or {}).items()):
-        saved = {}
-    saved["platform"] = platform
-    saved.update(match or {})
-    saved.setdefault("entries", {})[key] = {**entry, "_t": time.time()}
-    tmp = _path(name) + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(saved, f)
-    os.replace(tmp, _path(name))
+    with _bank_lock(name):
+        try:
+            with open(_path(name)) as f:
+                saved = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            saved = {}
+        if saved.get("platform") != platform or any(
+                saved.get(k) != v for k, v in (match or {}).items()):
+            saved = {}
+        saved["platform"] = platform
+        saved.update(match or {})
+        saved.setdefault("entries", {})[key] = {**entry,
+                                                "_t": time.time()}
+        tmp = _path(name) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(saved, f)
+        os.replace(tmp, _path(name))
 
 
 def strip(entry: dict) -> dict:

@@ -636,8 +636,15 @@ class _ChunkLoop(ir.Comp):
         import jax
         import jax.numpy as jnp
         from ziria_tpu.backend.hybrid import _env_rebuild
+        from ziria_tpu.frontend.externals import viterbi_mode
 
-        key = (struct, tuple(names), take_b, out_cap, is_for, iter_cap)
+        # the staged viterbi_soft ext reads ZIRIA_VITERBI_WINDOW /
+        # ZIRIA_VITERBI_METRIC at trace time, so the decode mode is
+        # part of this trace's identity: fold it into the cache key so
+        # an in-process env change re-traces instead of silently
+        # reusing the old mode (ADVICE r5 #1)
+        key = (struct, tuple(names), take_b, out_cap, is_for, iter_cap,
+               viterbi_mode())
         fn = self._fns.get(key)
         if fn is not None:
             return key, fn

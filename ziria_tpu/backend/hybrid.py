@@ -196,7 +196,12 @@ class _JitDo:
         except Exception:
             self._broken = True
             return self.closure(env)
-        fn = self._fns.get(struct)
+        # the staged viterbi_soft ext reads its window/metric mode from
+        # the environment at trace time — fold it into the do-block
+        # cache key so an in-process change re-traces (ADVICE r5 #1)
+        from ziria_tpu.frontend.externals import viterbi_mode
+        key = (struct, viterbi_mode())
+        fn = self._fns.get(key)
         if fn is None:
             closure = self.closure
 
@@ -206,12 +211,12 @@ class _JitDo:
                 return r, _env_refs(env2, struct)
 
             fn = jax.jit(raw)
-            self._fns[struct] = fn
+            self._fns[key] = fn
         try:
             ret, refs = fn(tuple(vals))
-            self._ok.add(struct)
+            self._ok.add(key)
         except Exception:
-            if struct in self._ok:
+            if key in self._ok:
                 # this block has compiled and run before: the failure is
                 # a runtime execution error (device OOM, backend flake),
                 # not un-jittable structure. Silently demoting to the

@@ -199,6 +199,33 @@ EXTERNALS: Dict[str, Callable] = {
 }
 
 
+def viterbi_mode() -> tuple:
+    """The process-wide staged-decode mode: ``(window, metric_dtype)``
+    from ZIRIA_VITERBI_WINDOW / ZIRIA_VITERBI_METRIC.
+
+    ONE reader for the env pair so the trace-time read in
+    ``_viterbi_soft`` and the backend compile-cache keys
+    (backend/chunked ``_get_fn``, backend/hybrid ``_JitDo``) can never
+    disagree: the mode is part of every cached program's key, so an
+    in-process change after tracing re-traces instead of silently
+    keeping the old decode mode (ADVICE r5 #1 — a code comment used to
+    be the only guard). An unparseable window degrades to 0 (off, the
+    safe default); an unknown metric raises — the quantized kernel is
+    an opt-in accuracy trade that must never be silently dropped."""
+    import os as _os
+
+    from ziria_tpu.ops.viterbi import METRIC_DTYPES
+    try:
+        win = int(_os.environ.get("ZIRIA_VITERBI_WINDOW", "0"))
+    except ValueError:
+        win = 0
+    md = _os.environ.get("ZIRIA_VITERBI_METRIC") or "float32"
+    if md not in METRIC_DTYPES:
+        raise ValueError(
+            f"ZIRIA_VITERBI_METRIC={md!r} is not one of {METRIC_DTYPES}")
+    return win, md
+
+
 def _viterbi_soft(llrs, npairs, nbits):
     """Block soft-decision Viterbi (K=7, g0=133o/g1=171o) over the first
     `npairs` (A,B) LLR pairs of a padded buffer; returns a bit array of
@@ -226,20 +253,19 @@ def _viterbi_soft(llrs, npairs, nbits):
     if isinstance(llrs, Tracer):
         # staged call (jit / hybrid do-block): static lengths make the
         # shapes static, so decode with the lax.scan ACS kernel — or,
-        # under the driver flag --viterbi-window / ZIRIA_VITERBI_WINDOW,
-        # the sliding-window PARALLEL Pallas decode: every compiled
-        # program's hot brick accelerates without a source change (the
-        # "one compiler serves every program" property; same result at
-        # operating SNR, tests/test_viterbi_windowed.py). Read at trace
-        # time: set the flag before compiling, not between runs.
-        import os as _os
-
+        # under the driver flags --viterbi-window / --viterbi-metric
+        # (env ZIRIA_VITERBI_WINDOW / ZIRIA_VITERBI_METRIC), the
+        # sliding-window PARALLEL Pallas decode and/or the int16
+        # saturating-metric quantized decode: every compiled program's
+        # hot brick accelerates without a source change (the "one
+        # compiler serves every program" property; same result at
+        # operating SNR, tests/test_viterbi_windowed.py /
+        # docs/quantized_viterbi.md). Read at trace time via
+        # viterbi_mode(), which the backend folds into its compile
+        # cache keys — changing the env after tracing re-traces.
         import jax.numpy as jnp
         arr = jnp.asarray(llrs, jnp.float32)
-        try:
-            win = int(_os.environ.get("ZIRIA_VITERBI_WINDOW", "0"))
-        except ValueError:
-            win = 0
+        win, metric = viterbi_mode()
         from ziria_tpu.ops import viterbi_pallas as _vp
         if win > 0 and npairs > win + 2 * _vp.DEFAULT_WINDOW_OVERLAP:
             # only frames long enough to actually window: short
@@ -247,10 +273,12 @@ def _viterbi_soft(llrs, npairs, nbits):
             # path) keep the scan kernel — the flag is a pure
             # optimization, never a kernel-launch tax (review r5)
             bits = _vp.viterbi_decode_batch_windowed(
-                arr[None, : 2 * npairs], n_bits=nbits, window=win)[0]
+                arr[None, : 2 * npairs], n_bits=nbits, window=win,
+                metric_dtype=metric)[0]
         else:
             from ziria_tpu.ops.viterbi import viterbi_decode
-            bits = viterbi_decode(arr[: 2 * npairs], n_bits=nbits)
+            bits = viterbi_decode(arr[: 2 * npairs], n_bits=nbits,
+                                  metric_dtype=metric)
         out = jnp.zeros(arr.shape[0] // 2, jnp.uint8)
         return out.at[:nbits].set(bits.astype(jnp.uint8))
     arr = np.asarray(llrs, np.float32)

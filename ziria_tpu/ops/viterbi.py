@@ -56,8 +56,99 @@ def _edge_tables():
 
 _PRED, _OUT_A, _OUT_B = _edge_tables()
 
+# --------------------------------------------------------------- quantized
+# int16 saturating path metrics — the reference's SORA discipline
+# (sora_ext_viterbi.c ran 16-bit metrics in SSE lanes; SURVEY.md §2.2).
+# Soft inputs quantize to [-QUANT_MAX, QUANT_MAX] integers; every branch
+# metric is then an exact small integer, so int arithmetic and f32
+# arithmetic agree bit-for-bit on the same quantized inputs as long as
+# the metrics stay in range (docs/quantized_viterbi.md derives the
+# bound). METRIC_DTYPES is the knob's whole legal surface — every layer
+# (kernel, externals, CLI) validates against it so a typo'd mode can
+# never silently fall back to f32.
 
-def viterbi_decode(llrs, n_bits: int = None) -> jnp.ndarray:
+QUANT_MAX = 127                  # 8-bit soft values, like SORA's bricks
+I16_MIN, I16_MAX = -(1 << 15), (1 << 15) - 1
+METRIC_DTYPES = ("float32", "int16")
+
+
+def quantize_llrs(llrs, qmax: int = QUANT_MAX):
+    """(…, 2) float LLRs -> (int16 quantized LLRs, f32 scale).
+
+    The scale maps the max |llr| onto ``qmax`` PER FRAME — for a
+    (B, T, 2) batch each lane gets its own scale (shape (B, 1, 1));
+    a lone (T, 2)/(2T,) frame gets a scalar. A positive uniform
+    scaling of one frame never changes its ACS decisions or end-state
+    argmax, so any per-frame scale is decode-equivalent and rounding
+    is the only lossy step. Per-frame (not batch-global) scaling is
+    what makes a frame's quantized decode independent of its
+    batch-mates: receive_many lanes match per-capture receive()
+    bit for bit. Traced-shape safe: scales are jnp values.
+    """
+    llrs = jnp.asarray(llrs, jnp.float32)
+    if llrs.ndim == 3:
+        peak = jnp.max(jnp.abs(llrs), axis=(1, 2), keepdims=True)
+    else:
+        peak = jnp.max(jnp.abs(llrs))
+    scale = qmax / jnp.maximum(peak, 1e-12)
+    q = jnp.clip(jnp.round(llrs * scale), -qmax, qmax)
+    return q.astype(jnp.int16), scale
+
+
+def _check_metric_dtype(metric_dtype):
+    md = metric_dtype or "float32"
+    if md not in METRIC_DTYPES:
+        raise ValueError(
+            f"metric_dtype {metric_dtype!r} is not one of {METRIC_DTYPES}")
+    return md
+
+
+def viterbi_decode_int16(qllrs, n_bits: int = None) -> jnp.ndarray:
+    """Decode pre-quantized int LLR pairs with int16 saturating
+    metrics — the lax.scan ORACLE of the quantized semantics (the
+    Pallas int16 kernel in ops/viterbi_pallas.py is tested against
+    this, and this against the f32 decode on the same inputs).
+
+    Arithmetic runs in int32 and every renormalized metric saturates
+    into [I16_MIN, I16_MAX] — exactly what the kernel's int16 VMEM
+    scratch enforces. Saturation only ever touches unreachable states
+    (see docs/quantized_viterbi.md), so the decoded path matches the
+    f32 decode bit-for-bit on in-range inputs.
+    """
+    q = jnp.asarray(qllrs, jnp.int32)
+    if q.ndim == 1:
+        q = q.reshape(-1, 2)
+
+    pred = jnp.asarray(_PRED)
+    out_a = jnp.asarray(_OUT_A, np.float32).astype(jnp.int32)
+    out_b = jnp.asarray(_OUT_B, np.float32).astype(jnp.int32)
+
+    init = jnp.full((N_STATES,), I16_MIN, jnp.int32).at[0].set(0)
+
+    def acs(metrics, llr):
+        cand = metrics[pred] + out_a * llr[0] + out_b * llr[1]
+        best = jnp.argmax(cand, axis=1).astype(jnp.uint8)
+        new = jnp.max(cand, axis=1)
+        new = new - jnp.max(new)           # renormalize: max pinned at 0
+        new = jnp.clip(new, I16_MIN, I16_MAX)   # saturating int16 store
+        return new, best
+
+    metrics, decisions = jax.lax.scan(acs, init, q)
+    end_state = jnp.argmax(metrics).astype(jnp.int32)
+
+    def back(state, dec):
+        bit = (state >> 5).astype(jnp.uint8)
+        prev = pred[state, dec[state]]
+        return prev, bit
+
+    _, bits = jax.lax.scan(back, end_state, decisions, reverse=True)
+    if n_bits is not None:
+        bits = bits[:n_bits]
+    return bits
+
+
+def viterbi_decode(llrs, n_bits: int = None,
+                   metric_dtype: str = None) -> jnp.ndarray:
     """Decode soft values.
 
     llrs: (2T,) or (T, 2) float — reliabilities for coded bits (A_k, B_k);
@@ -67,7 +158,14 @@ def viterbi_decode(llrs, n_bits: int = None) -> jnp.ndarray:
     stream that IS state 0 at reasonable SNR, and argmax degrades more
     gracefully when it isn't. Returns (T,) decoded bits; the caller
     slices off tail/pad (or passes n_bits to do it here).
+
+    ``metric_dtype="int16"`` quantizes the LLRs (quantize_llrs) and
+    decodes with int16 saturating metrics — the SORA trade; see
+    viterbi_decode_int16 for the semantics.
     """
+    if _check_metric_dtype(metric_dtype) == "int16":
+        q, _scale = quantize_llrs(llrs)
+        return viterbi_decode_int16(q, n_bits)
     llrs = jnp.asarray(llrs, jnp.float32)
     if llrs.ndim == 1:
         llrs = llrs.reshape(-1, 2)

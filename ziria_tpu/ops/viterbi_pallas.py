@@ -40,13 +40,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ziria_tpu.ops.coding import G0, G1
-from ziria_tpu.ops.viterbi import N_STATES
+from ziria_tpu.ops.viterbi import (I16_MAX, I16_MIN, N_STATES,
+                                   _check_metric_dtype, quantize_llrs)
 
 LANES = 128
 _NEG = -1e30
 
 
-def _branch_coeffs():
+def _branch_coeffs(dtype=jnp.float32):
     """(A0, A1, B0, B1): ±1 branch-metric coefficient columns (64, 1).
 
     Computed from an iota inside the trace (Pallas kernels cannot capture
@@ -62,7 +63,7 @@ def _branch_coeffs():
         win = [b] + [(s >> (5 - i)) & 1 for i in range(6)]
         for taps in (G0, G1):
             acc = sum(int(g) * w for g, w in zip(taps, win)) % 2
-            cols.append((2 * acc - 1).astype(jnp.float32))
+            cols.append((2 * acc - 1).astype(dtype))
     a0, b0, a1, b1 = cols
     return a0, a1, b0, b1
 
@@ -73,6 +74,19 @@ def _branch_coeffs():
 # for T=8208 at B=128). Unrolling K steps into one kernel body cuts the
 # grid by K at ~K x program size.
 UNROLL = 64
+
+
+def _pack_sel():
+    """(8, 64) bit-packing matrix: sel[i, s] is (1 << (s & 7)) when s
+    lives in byte i (s >> 3 == i), else 0, so sel @ dec gives byte i =
+    sum_j dec[8i+j] << j exactly (all values are small ints, exact in
+    f32). ONE MXU matmul per step replaces 64 row-slice VPU ops — the
+    kernel is issue-bound, not FLOP-bound. Shared by both metric-dtype
+    kernels so the packed decision format can never diverge."""
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (8, N_STATES), 1)
+    b_idx = jax.lax.broadcasted_iota(jnp.int32, (8, N_STATES), 0)
+    return jnp.where((s_idx >> 3) == b_idx,
+                     (1 << (s_idx & 7)).astype(jnp.float32), 0.0)
 
 
 def _acs_kernel(llr_ref, dec_ref, metrics_out_ref, m_ref):
@@ -92,15 +106,7 @@ def _acs_kernel(llr_ref, dec_ref, metrics_out_ref, m_ref):
         m_ref[:] = jnp.where(rows == 0, 0.0, _NEG).astype(jnp.float32)
 
     a0, a1, b0, b1 = _branch_coeffs()
-    # bit-packing as ONE MXU matmul per step: sel[i, s] is
-    # (1 << (s & 7)) when s lives in byte i (s >> 3 == i), else 0, so
-    # sel @ dec gives byte i = sum_j dec[8i+j] << j exactly (all values
-    # are small ints, exact in f32). Replaces 64 row-slice VPU ops per
-    # step — the kernel is issue-bound, not FLOP-bound.
-    s_idx = jax.lax.broadcasted_iota(jnp.int32, (8, N_STATES), 1)
-    b_idx = jax.lax.broadcasted_iota(jnp.int32, (8, N_STATES), 0)
-    sel = jnp.where((s_idx >> 3) == b_idx,
-                    (1 << (s_idx & 7)).astype(jnp.float32), 0.0)
+    sel = _pack_sel()
 
     m = m_ref[:]                                  # (64, 128)
     for j in range(UNROLL):
@@ -130,6 +136,63 @@ def _acs_kernel(llr_ref, dec_ref, metrics_out_ref, m_ref):
     @pl.when(t == pl.num_programs(1) - 1)
     def _flush():
         metrics_out_ref[0] = m_ref[:]
+
+
+def _acs_kernel_i16(llr_ref, dec_ref, metrics_out_ref, m_ref):
+    """int16 saturating-metric ACS sweep — the SORA trade (SURVEY.md
+    §2.2: the reference brick ran 16-bit path metrics across SSE
+    lanes). Same trellis walk and packed decision format as
+    _acs_kernel; what changes is storage width:
+
+    llr_ref: (1, UNROLL, 2, 128) int16 — QUANTIZED soft inputs
+      (ops.viterbi.quantize_llrs, |q| <= QUANT_MAX), HALF the f32
+      kernel's dominant HBM input stream.
+    m_ref: (64, 128) int16 VMEM scratch — half the metric footprint,
+      doubling sublane density of the resident state.
+    metrics_out_ref: (64, 128) int32 (traceback only argmaxes it).
+
+    Arithmetic runs in int32 vregs across the UNROLL block (exact: the
+    in-block drift is <= UNROLL * 2 * QUANT_MAX = 16256 from a
+    renormed max of 0, far inside int32); the once-per-block renorm
+    pins the max at 0 and the store back to int16 SATURATES — which
+    only ever clips unreachable/floored states, never the surviving
+    path (docs/quantized_viterbi.md has the bound), so the decode
+    matches the f32 kernel bit-for-bit on the same quantized inputs.
+    """
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        rows = jax.lax.broadcasted_iota(jnp.int32, (N_STATES, LANES), 0)
+        m_ref[:] = jnp.where(rows == 0, 0, I16_MIN).astype(jnp.int16)
+
+    a0, a1, b0, b1 = _branch_coeffs(jnp.int32)
+    sel = _pack_sel()
+
+    m = m_ref[:].astype(jnp.int32)                # (64, 128)
+    for j in range(UNROLL):
+        la = llr_ref[0, j, 0:1, :].astype(jnp.int32)   # (1, 128)
+        lb = llr_ref[0, j, 1:2, :].astype(jnp.int32)
+
+        pairs = m.reshape(32, 2, LANES)
+        ev = jnp.concatenate([pairs[:, 0, :]] * 2, axis=0)  # pred d=0
+        od = jnp.concatenate([pairs[:, 1, :]] * 2, axis=0)  # pred d=1
+
+        cand0 = ev + a0 * la + b0 * lb
+        cand1 = od + a1 * la + b1 * lb
+
+        dec = cand1 > cand0
+        m = jnp.maximum(cand0, cand1)
+
+        packed = jax.lax.dot(sel, dec.astype(jnp.float32),
+                             precision=jax.lax.Precision.HIGHEST)
+        dec_ref[0, j] = packed.astype(jnp.int32).astype(jnp.uint8)
+    m = m - jnp.max(m, axis=0, keepdims=True)
+    m_ref[:] = jnp.clip(m, I16_MIN, I16_MAX).astype(jnp.int16)
+
+    @pl.when(t == pl.num_programs(1) - 1)
+    def _flush():
+        metrics_out_ref[0] = m_ref[:].astype(jnp.int32)
 
 
 def _traceback_kernel(dec_ref, metrics_ref, bits_ref, s_ref):
@@ -173,9 +236,13 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _decode_tiles(llrs, interpret: bool):
-    """(nb, T, 2, 128) f32 -> (nb, T, 128) uint8 decoded bit planes."""
+@functools.partial(jax.jit, static_argnames=("interpret", "metric_dtype"))
+def _decode_tiles(llrs, interpret: bool, metric_dtype: str = "float32"):
+    """(nb, T, 2, 128) f32|int16 -> (nb, T, 128) uint8 decoded bit
+    planes. ``metric_dtype`` picks the ACS kernel: "float32" (oracle/
+    default, f32 llr tiles) or "int16" (quantized llr tiles, int16
+    saturating metrics)."""
+    i16 = metric_dtype == "int16"
     nb, T = llrs.shape[0], llrs.shape[1]
     # pad the trellis to a multiple of UNROLL with zero LLRs (erasures:
     # they add no likelihood, so the surviving path over the real prefix
@@ -186,7 +253,7 @@ def _decode_tiles(llrs, interpret: bool):
     TB = Tp // UNROLL                       # grid blocks per trellis
 
     dec, metrics = pl.pallas_call(
-        _acs_kernel,
+        _acs_kernel_i16 if i16 else _acs_kernel,
         grid=(nb, TB),
         in_specs=[pl.BlockSpec((1, UNROLL, 2, LANES),
                                lambda b, t: (b, t, 0, 0))],
@@ -196,9 +263,11 @@ def _decode_tiles(llrs, interpret: bool):
         ],
         out_shape=[
             jax.ShapeDtypeStruct((nb, Tp, 8, LANES), jnp.uint8),
-            jax.ShapeDtypeStruct((nb, N_STATES, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((nb, N_STATES, LANES),
+                                 jnp.int32 if i16 else jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((N_STATES, LANES), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((N_STATES, LANES),
+                                   jnp.int16 if i16 else jnp.float32)],
         interpret=interpret,
     )(llrs)
 
@@ -220,26 +289,40 @@ def _decode_tiles(llrs, interpret: bool):
     return bits[:, :T, 0, :].astype(jnp.uint8)
 
 
-def viterbi_decode_batch(llrs, n_bits: int = None, interpret: bool = None):
+def viterbi_decode_batch(llrs, n_bits: int = None, interpret: bool = None,
+                         metric_dtype: str = None):
     """Batched soft decode: llrs (B, T, 2) or (B, 2T) -> (B, T) bits.
 
     Same contract as ops.viterbi.viterbi_decode but over a whole batch of
     frames — the bench/TPU fast path. Lanes are padded to a multiple of
     128 with zero LLRs (erasures), which decode to garbage in the pad
     lanes and are sliced off.
+
+    ``metric_dtype="int16"`` quantizes the LLRs at the kernel boundary
+    (ops.viterbi.quantize_llrs, PER-frame scale) and runs the int16
+    saturating-metric ACS kernel: half the llr HBM stream, half the
+    metric VMEM footprint. Already-int16 input is taken as
+    pre-quantized and passed through untouched (the windowed decode
+    quantizes before cutting windows). Default/"float32" is the exact
+    oracle kernel.
     """
     if interpret is None:
         interpret = _interpret_default()
-    llrs = jnp.asarray(llrs, jnp.float32)
+    md = _check_metric_dtype(metric_dtype)
+    llrs = jnp.asarray(llrs)
     if llrs.ndim == 2:
         llrs = llrs.reshape(llrs.shape[0], -1, 2)
+    if md != "int16":
+        llrs = llrs.astype(jnp.float32)
+    elif llrs.dtype != jnp.int16:
+        llrs, _scale = quantize_llrs(llrs)              # int16 (B, T, 2)
     B, T = llrs.shape[0], llrs.shape[1]
     Bp = -(-B // LANES) * LANES
     # (B, T, 2) -> (T, 2, B) -> lane tiles (nb, T, 2, 128)
     x = jnp.transpose(llrs, (1, 2, 0))
     x = jnp.pad(x, ((0, 0), (0, 0), (0, Bp - B)))
     x = x.reshape(T, 2, Bp // LANES, LANES).transpose(2, 0, 1, 3)
-    bits = _decode_tiles(x, interpret)                  # (nb, T, 128)
+    bits = _decode_tiles(x, interpret, md)              # (nb, T, 128)
     bits = bits.transpose(0, 2, 1).reshape(Bp, T)[:B]
     if n_bits is not None:
         bits = bits[:, :n_bits]
@@ -251,21 +334,26 @@ DEFAULT_WINDOW_OVERLAP = 96   # ~14 constraint lengths of warmup
 
 def viterbi_decode_batch_opt(llrs, n_bits: int = None,
                              window: int = None,
-                             interpret: bool = None):
-    """ONE dispatch for the batch decode's window option (review r5:
-    the if/else was copied at every call site): ``window=None/0`` runs
-    the exact kernel, ``window=N`` the sliding-window parallel decode
-    below."""
+                             interpret: bool = None,
+                             metric_dtype: str = None):
+    """ONE dispatch for the batch decode's window/metric options
+    (review r5: the if/else was copied at every call site):
+    ``window=None/0`` runs the exact kernel, ``window=N`` the
+    sliding-window parallel decode below; ``metric_dtype`` selects the
+    f32 oracle or int16 saturating kernel either way."""
     if window:
         return viterbi_decode_batch_windowed(
-            llrs, n_bits=n_bits, window=window, interpret=interpret)
-    return viterbi_decode_batch(llrs, n_bits=n_bits, interpret=interpret)
+            llrs, n_bits=n_bits, window=window, interpret=interpret,
+            metric_dtype=metric_dtype)
+    return viterbi_decode_batch(llrs, n_bits=n_bits, interpret=interpret,
+                                metric_dtype=metric_dtype)
 
 
 def viterbi_decode_batch_windowed(llrs, n_bits: int = None,
                                   window: int = 1024,
                                   overlap: int = DEFAULT_WINDOW_OVERLAP,
                                   interpret: bool = None,
+                                  metric_dtype: str = None,
                                   _decode=None):
     """Sliding-window PARALLEL decode: cut the T-step dependency chain
     into ceil(T/window) overlapping windows and run them as EXTRA BATCH
@@ -296,15 +384,28 @@ def viterbi_decode_batch_windowed(llrs, n_bits: int = None,
     """
     if interpret is None:
         interpret = _interpret_default()
+    md = _check_metric_dtype(metric_dtype)
     if _decode is None:
         # the production engine; tools/windowed_ber.py injects the
         # lax.scan engine so the BER study measures exactly this
         # windowing math without interpret-mode Pallas cost on CPU
         def _decode(x):
-            return viterbi_decode_batch(x, interpret=interpret)
-    llrs = jnp.asarray(llrs, jnp.float32)
+            return viterbi_decode_batch(x, interpret=interpret,
+                                        metric_dtype=md)
+    llrs = jnp.asarray(llrs)
     if llrs.ndim == 2:
         llrs = llrs.reshape(llrs.shape[0], -1, 2)
+    if md == "int16":
+        # quantize PER FRAME **before** cutting windows: every window
+        # then slices the exact integers the full-frame decode sees
+        # (the batch decode passes int16 through untouched), so
+        # windowed int16 == full int16 by the same survivor-merge
+        # argument as f32 — and no lane's scale depends on its
+        # batch-mates. An injected _decode must accept int16 input.
+        if llrs.dtype != jnp.int16:
+            llrs, _scale = quantize_llrs(llrs)
+    else:
+        llrs = llrs.astype(jnp.float32)
     B, T = llrs.shape[0], llrs.shape[1]
     ext = window + 2 * overlap
     if T <= ext:
@@ -320,8 +421,10 @@ def viterbi_decode_batch_windowed(llrs, n_bits: int = None,
     # negative warmup positions clip to 0 and feed repeated
     # full-confidence position-0 LLRs into the warmup instead of
     # neutral erasures
-    valid = ((idx >= 0) & (idx < T)).astype(jnp.float32)
-    wins = llrs[:, jnp.clip(idx, 0, T - 1), :] * valid[None, :, :, None]
+    valid = (idx >= 0) & (idx < T)
+    wins = jnp.where(valid[None, :, :, None],
+                     llrs[:, jnp.clip(idx, 0, T - 1), :],
+                     jnp.zeros((), llrs.dtype))
     bits = _decode(wins.reshape(B * nwin, ext, 2))
     bits = bits.reshape(B, nwin, ext)
     keep = (jnp.where(jnp.arange(nwin) == 0, 0, overlap)[:, None]

@@ -120,7 +120,8 @@ def decode_data_static(frame, rate: RateParams, n_sym: int,
 
 def decode_data_batch(frames, rate: RateParams, n_sym: int,
                       n_psdu_bits: int, interpret: bool = None,
-                      viterbi_window: int = None):
+                      viterbi_window: int = None,
+                      viterbi_metric: str = None):
     """Batched DATA decode: (B, frame_len, 2) -> ((B, n_psdu_bits),
     (B, 16)).
 
@@ -134,11 +135,15 @@ def decode_data_batch(frames, rate: RateParams, n_sym: int,
     cut into overlapping windows decoded as extra batch lanes — the
     standard truncated-traceback trade every production decoder
     (including the reference's SORA brick) makes, bit-identical to the
-    exact decode at operating SNR (tests/test_viterbi_windowed.py)."""
+    exact decode at operating SNR (tests/test_viterbi_windowed.py).
+
+    ``viterbi_metric="int16"`` opts into the quantized saturating-
+    metric kernel (the SORA int16 discipline; docs/quantized_viterbi.md
+    — the other half of the device-residency trade)."""
     dep = jax.vmap(lambda f: _decode_front(f, rate, n_sym))(frames)
     bits = viterbi_pallas.viterbi_decode_batch_opt(
         dep, n_bits=n_sym * rate.n_dbps, window=viterbi_window,
-        interpret=interpret)
+        interpret=interpret, metric_dtype=viterbi_metric)
     return jax.vmap(lambda b: _decode_back(b, n_psdu_bits))(bits)
 
 
@@ -189,7 +194,8 @@ class RxResult(NamedTuple):
 
 
 def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
-                         n_bits_real, viterbi_window: int = None):
+                         n_bits_real, viterbi_window: int = None,
+                         viterbi_metric: str = None):
     """DATA decode over a *bucketed* symbol count: `frame` is padded to
     FRAME_DATA_START + 80*n_sym_bucket samples, `n_bits_real` is the
     true data-bit count as a TRACED scalar. Returns the full descrambled
@@ -211,10 +217,11 @@ def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
         # ops/viterbi_pallas.viterbi_decode_batch_windowed)
         bits = viterbi_pallas.viterbi_decode_batch_windowed(
             depunct[None], n_bits=n_sym_bucket * rate.n_dbps,
-            window=viterbi_window)[0]
+            window=viterbi_window, metric_dtype=viterbi_metric)[0]
     else:
         bits = viterbi.viterbi_decode(
-            depunct, n_bits=n_sym_bucket * rate.n_dbps)
+            depunct, n_bits=n_sym_bucket * rate.n_dbps,
+            metric_dtype=viterbi_metric)
     seed = scramble.recover_seed(bits[:7])
     return scramble.descramble_bits(bits, seed)
 
@@ -222,7 +229,8 @@ def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
 @lru_cache(maxsize=None)
 def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
                               fxp: bool = False,
-                              viterbi_window: int = None):
+                              viterbi_window: int = None,
+                              viterbi_metric: str = None):
     rate = RATES[rate_mbps]
 
     if fxp:
@@ -234,7 +242,8 @@ def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
     else:
         def f(frame, n_bits_real):
             return decode_data_bucketed(frame, rate, n_sym_bucket,
-                                        n_bits_real, viterbi_window)
+                                        n_bits_real, viterbi_window,
+                                        viterbi_metric)
 
     return jax.jit(f)
 
@@ -245,13 +254,173 @@ def _sym_bucket(n_sym: int) -> int:
     return 1 << max(2, (n_sym - 1).bit_length())
 
 
+# ------------------------------------------------------- mixed-rate dispatch
+
+MAX_DBPS = max(p.n_dbps for p in RATES.values())     # 216 (54 Mbps)
+RATE_MBPS_ORDER = tuple(sorted(RATES))               # lax.switch branch order
+RATE_INDEX = {m: i for i, m in enumerate(RATE_MBPS_ORDER)}
+
+
+def decode_data_mixed(frames, rate_idx, n_bits_real, n_sym_bucket: int,
+                      viterbi_window: int = None,
+                      viterbi_metric: str = None,
+                      interpret: bool = None):
+    """Mixed-rate batched DATA decode in ONE device dispatch — the
+    compiled-program analogue of Ziria's in-language rate dispatch
+    (the reference's `parsePLCPHeader ; per-rate loop` runs INSIDE the
+    compiled receiver; SURVEY.md §3.4, §7 step 6).
+
+    frames: (B, FRAME_DATA_START + 80*n_sym_bucket, 2) aligned,
+    CFO-corrected frames padded to ONE common symbol bucket;
+    rate_idx: (B,) int32 indices into RATE_MBPS_ORDER (traced);
+    n_bits_real: (B,) int32 true data-bit counts (traced).
+    Returns (B, n_sym_bucket * MAX_DBPS) descrambled bit streams; the
+    caller slices each lane's PSDU.
+
+    Geometry trick that makes one `lax.switch` serve all 8 rates: each
+    per-rate branch runs only the CHEAP front end (FFT/equalize/demap/
+    deinterleave/depuncture) at its own rate and pads the depunctured
+    LLRs to the bucket's maximal trellis (n_sym_bucket * MAX_DBPS)
+    with zero-LLR erasures — the same "adds no likelihood" argument as
+    the symbol-bucket padding, so the surviving path over each lane's
+    real prefix is exactly its unpadded ML path. The EXPENSIVE Viterbi
+    then runs once, rate-agnostic, over the whole mixed batch through
+    the Pallas kernel with every lane riding the same 128-lane tiles —
+    mixed traffic no longer fragments the hot kernel's batch. Under
+    vmap the switch lowers to a select over the (cheap) front-end
+    branches; the per-lane trellis work is never duplicated.
+
+    vs the host-side bucketed path (`receive`): compile count for the
+    DATA stage drops from O(rates x log lengths) to O(log lengths),
+    and a mixed-rate batch costs ONE device call instead of one per
+    rate group.
+    """
+    t_max = n_sym_bucket * MAX_DBPS
+
+    def _branch(rate):
+        def f(frame):
+            dep = _decode_front(frame, rate, n_sym_bucket)
+            return jnp.pad(dep, ((0, t_max - dep.shape[0]), (0, 0)))
+        return f
+
+    branches = [_branch(RATES[m]) for m in RATE_MBPS_ORDER]
+    rate_idx = jnp.asarray(rate_idx, jnp.int32)
+    n_bits_real = jnp.asarray(n_bits_real, jnp.int32)
+    dep = jax.vmap(
+        lambda f, r: jax.lax.switch(r, branches, f))(frames, rate_idx)
+    # rows at/after each lane's true bit count become erasures (covers
+    # both the in-rate bucket pad and the cross-rate pad to MAX_DBPS)
+    t = jnp.arange(t_max)
+    dep = jnp.where((t[None, :] < n_bits_real[:, None])[..., None],
+                    dep, 0.0)
+    bits = viterbi_pallas.viterbi_decode_batch_opt(
+        dep, window=viterbi_window, metric_dtype=viterbi_metric,
+        interpret=interpret)
+
+    def _descramble(b):
+        seed = scramble.recover_seed(b[:7])
+        return scramble.descramble_bits(b, seed)
+
+    return jax.vmap(_descramble)(bits)
+
+
+@lru_cache(maxsize=None)
+def _jit_decode_data_mixed(n_sym_bucket: int, viterbi_window: int = None,
+                           viterbi_metric: str = None):
+    """ONE jit per (symbol bucket, decode mode) serving ALL rates —
+    the decode-mode knobs are part of the cache key, so an in-process
+    change can never silently reuse the other mode's trace (ADVICE r5
+    #1 discipline)."""
+    def f(frames, rate_idx, n_bits_real):
+        return decode_data_mixed(frames, rate_idx, n_bits_real,
+                                 n_sym_bucket, viterbi_window,
+                                 viterbi_metric)
+    return jax.jit(f)
+
+
 _jit_sync = None
 _jit_signal = None
 
 
+class _Acquired(NamedTuple):
+    """A detected, SIGNAL-parsed capture, ready for a DATA decode."""
+    frame_np: np.ndarray        # samples from the frame start (f32)
+    avail: int                  # true capture samples past the start
+    eps: float                  # CFO estimate
+    rate_mbps: int
+    length_bytes: int
+    n_sym: int
+
+
+def _acquire_frame(samples, max_samples: int = 1 << 16):
+    """Detect/align/CFO-correct a capture and parse its SIGNAL field:
+    the shared acquisition front of `receive` and the frame-batched
+    `backend.framebatch.receive_many`. Returns (RxResult, None) on any
+    failure, (None, _Acquired) on success."""
+    global _jit_sync, _jit_signal
+    if _jit_sync is None:
+        _jit_sync = jax.jit(sync_frame)
+        _jit_signal = jax.jit(
+            lambda fr: decode_signal(fr))
+
+    fail = RxResult(False, 0, 0, np.zeros(0, np.uint8), None)
+    x = np.asarray(samples, np.float32)[:max_samples]
+    n_valid = x.shape[0]  # true capture length, before bucket padding
+    # pad to a power-of-two bucket so the sync jit compiles once per
+    # bucket, not once per stream length (zeros are inert to detection)
+    bucket = 1 << max(9, (n_valid - 1).bit_length())
+    if bucket != n_valid:
+        x = np.concatenate(
+            [x, np.zeros((bucket - n_valid, 2), np.float32)], axis=0)
+    found, start, eps = _jit_sync(x)
+    if not bool(np.asarray(found)):
+        return fail, None
+    start = int(np.asarray(start))
+    eps = float(np.asarray(eps))
+
+    # all length checks use the true capture length — decoding padding
+    # zeros as DATA must fail, not silently "succeed"
+    frame_np = x[start:]
+    avail = n_valid - start
+    if avail < 400:
+        return fail, None
+    # CFO-correct only fixed-size regions so device code caches: the
+    # 400-sample head now, the (rate, n_sym)-sized data region after the
+    # SIGNAL parse (both slices start at the frame start, keeping the
+    # rotation phase-continuous)
+    head = sync.correct_cfo(jnp.asarray(frame_np[:400]), eps)
+    rate_bits, length, parity_ok = _jit_signal(head)
+    if not bool(np.asarray(parity_ok)):
+        return fail, None
+    rate_mbps = SIGNAL_BITS_TO_MBPS.get(int(np.asarray(rate_bits)))
+    if rate_mbps is None:
+        return fail, None
+    length_bytes = int(np.asarray(length))
+    rate = RATES[rate_mbps]
+    n_sym = n_symbols(length_bytes, rate)
+    need = FRAME_DATA_START + 80 * n_sym
+    if avail < need:
+        return RxResult(False, rate_mbps, length_bytes,
+                        np.zeros(0, np.uint8), None), None
+    return None, _Acquired(frame_np, avail, eps, rate_mbps,
+                           length_bytes, n_sym)
+
+
+def _padded_segment(acq: _Acquired, n_sym_bucket: int):
+    """The acquired frame's data region padded to `n_sym_bucket`
+    symbols and CFO-corrected: the fixed-geometry device input of the
+    bucketed and mixed-rate DATA decodes."""
+    need_b = FRAME_DATA_START + 80 * n_sym_bucket
+    frame_pad = np.zeros((need_b, 2), np.float32)
+    n = min(acq.avail, need_b)
+    frame_pad[:n] = acq.frame_np[:n]
+    return sync.correct_cfo(jnp.asarray(frame_pad), acq.eps)
+
+
 def receive(samples, check_fcs: bool = False,
             max_samples: int = 1 << 16, fxp: bool = False,
-            viterbi_window: int = None) -> RxResult:
+            viterbi_window: int = None,
+            viterbi_metric: str = None) -> RxResult:
     """Host-side receiver driver: detect, align, CFO-correct, parse
     SIGNAL, dispatch the per-rate decoder — the jit analogue of the
     reference's header-driven rate dispatch. The data decode compiles
@@ -269,73 +438,32 @@ def receive(samples, check_fcs: bool = False,
 
     viterbi_window opts the (float) DATA decode into the sliding-
     window parallel Viterbi — same result at operating SNR, ~T/window
-    less sequential trellis depth on the chip (ignored under fxp,
-    whose decode keeps the exact scan).
+    less sequential trellis depth on the chip; viterbi_metric="int16"
+    opts it into the quantized saturating-metric kernel (both ignored
+    under fxp, whose decode keeps the exact scan).
     """
-    global _jit_sync, _jit_signal
-    if _jit_sync is None:
-        _jit_sync = jax.jit(sync_frame)
-        _jit_signal = jax.jit(
-            lambda fr: decode_signal(fr))
-
-    x = np.asarray(samples, np.float32)[:max_samples]
-    n_valid = x.shape[0]  # true capture length, before bucket padding
-    # pad to a power-of-two bucket so the sync jit compiles once per
-    # bucket, not once per stream length (zeros are inert to detection)
-    bucket = 1 << max(9, (n_valid - 1).bit_length())
-    if bucket != n_valid:
-        x = np.concatenate(
-            [x, np.zeros((bucket - n_valid, 2), np.float32)], axis=0)
-    found, start, eps = _jit_sync(x)
-    if not bool(np.asarray(found)):
-        return RxResult(False, 0, 0, np.zeros(0, np.uint8), None)
-    start = int(np.asarray(start))
-    eps = float(np.asarray(eps))
-
-    # all length checks use the true capture length — decoding padding
-    # zeros as DATA must fail, not silently "succeed"
-    frame_np = x[start:]
-    avail = n_valid - start
-    if avail < 400:
-        return RxResult(False, 0, 0, np.zeros(0, np.uint8), None)
-    # CFO-correct only fixed-size regions so device code caches: the
-    # 400-sample head now, the (rate, n_sym)-sized data region after the
-    # SIGNAL parse (both slices start at the frame start, keeping the
-    # rotation phase-continuous)
-    head = sync.correct_cfo(jnp.asarray(frame_np[:400]), eps)
-    rate_bits, length, parity_ok = _jit_signal(head)
-    if not bool(np.asarray(parity_ok)):
-        return RxResult(False, 0, 0, np.zeros(0, np.uint8), None)
-    rate_mbps = SIGNAL_BITS_TO_MBPS.get(int(np.asarray(rate_bits)))
-    if rate_mbps is None:
-        return RxResult(False, 0, 0, np.zeros(0, np.uint8), None)
-    length_bytes = int(np.asarray(length))
-    rate = RATES[rate_mbps]
-    n_sym = n_symbols(length_bytes, rate)
-    need = FRAME_DATA_START + 80 * n_sym
-    if avail < need:
-        return RxResult(False, rate_mbps, length_bytes,
-                        np.zeros(0, np.uint8), None)
+    res, acq = _acquire_frame(samples, max_samples)
+    if acq is None:
+        return res
+    rate = RATES[acq.rate_mbps]
 
     # bucketed dispatch: pad the frame to a power-of-two symbol count so
     # the decode jit-caches O(rates x log lengths), not once per PSDU
     # length; the true bit count flows in as a traced scalar
-    n_sym_b = _sym_bucket(n_sym)
-    need_b = FRAME_DATA_START + 80 * n_sym_b
-    frame_pad = np.zeros((need_b, 2), np.float32)
-    frame_pad[:min(avail, need_b)] = frame_np[:min(avail, need_b)]
-    seg = sync.correct_cfo(jnp.asarray(frame_pad), eps)
+    n_sym_b = _sym_bucket(acq.n_sym)
+    seg = _padded_segment(acq, n_sym_b)
     if fxp:
         from ziria_tpu.phy.wifi import rx_fxp
         # AGC at the fixed-point boundary: unit average power over the
         # real preamble (numpy host math — stable for a given capture)
-        rms = float(np.sqrt(np.mean(frame_np[:320].astype(np.float64)
+        rms = float(np.sqrt(np.mean(acq.frame_np[:320].astype(np.float64)
                                     ** 2) * 2.0))
         seg = rx_fxp.quantize_frame(np.asarray(seg) / max(rms, 1e-12))
-    dec = _jit_decode_data_bucketed(rate_mbps, n_sym_b, fxp,
-                                    None if fxp else viterbi_window)
+    dec = _jit_decode_data_bucketed(acq.rate_mbps, n_sym_b, fxp,
+                                    None if fxp else viterbi_window,
+                                    None if fxp else viterbi_metric)
     clear = np.asarray(
-        dec(seg, jnp.int32(n_sym * rate.n_dbps)), np.uint8)
-    psdu = clear[N_SERVICE_BITS: N_SERVICE_BITS + 8 * length_bytes]
+        dec(seg, jnp.int32(acq.n_sym * rate.n_dbps)), np.uint8)
+    psdu = clear[N_SERVICE_BITS: N_SERVICE_BITS + 8 * acq.length_bytes]
     crc = bool(np.asarray(check_crc32(psdu))) if check_fcs else None
-    return RxResult(True, rate_mbps, length_bytes, psdu, crc)
+    return RxResult(True, acq.rate_mbps, acq.length_bytes, psdu, crc)

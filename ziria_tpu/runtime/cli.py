@@ -31,6 +31,11 @@ import sys
 import time
 from typing import Callable, Dict, Optional
 
+try:
+    import fcntl
+except ImportError:                   # pragma: no cover - non-POSIX
+    fcntl = None
+
 import numpy as np
 
 from ziria_tpu.runtime.buffers import ITEM_TYPES, StreamSpec, read_stream, \
@@ -229,6 +234,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(window N, e.g. 1024): ~T/N less sequential "
                         "trellis depth on chip, same result at "
                         "operating SNR; also via ZIRIA_VITERBI_WINDOW")
+    # choices mirror ops.viterbi.METRIC_DTYPES (asserted by
+    # tests/test_viterbi_int16.py::test_cli_choices_mirror_metric_dtypes)
+    # — not imported here so --help stays cheap
+    p.add_argument("--viterbi-metric", default=None,
+                   choices=["float32", "int16"],
+                   help="path-metric dtype for every staged "
+                        "viterbi_soft ext: int16 runs the quantized "
+                        "saturating-metric Pallas kernel (the SORA "
+                        "trade — half the LLR stream and metric "
+                        "footprint; docs/quantized_viterbi.md), "
+                        "float32 the exact oracle (default); also via "
+                        "ZIRIA_VITERBI_METRIC")
     return p
 
 
@@ -291,6 +308,13 @@ def _apply_platform(name: Optional[str]) -> None:
 # the box-wide TPU mutual-exclusion flag (same path bench.py and
 # tools/tpu_watcher.sh serialize on); module-level so tests can inject
 TPU_BUSY_FLAG = "/tmp/tpu_busy"
+BUSY_STALE_S = 35 * 60          # bench.py's leaked-flag threshold
+
+# a successful backend probe this recent is trusted without re-probing:
+# the healthy path used to pay a full extra backend init per CLI
+# invocation of a long-lived embedder process (ADVICE r5 #2)
+PROBE_OK_TTL_S = 300.0
+_probe_ok_t = 0.0
 
 
 def _backend_probe_failed(timeout_s: float, probe_argv=None) -> bool:
@@ -368,25 +392,132 @@ def _fastfail_dead_backend(args) -> Optional[int]:
     # means another client (watcher harvest, bench) holds the backend —
     # it is busy, not dead, and a second axon client would hang BOTH.
     # Diagnose without touching the backend.
+    if _busy_flag_fresh():
+        return _report_held()
+    global _probe_ok_t
+    if time.time() - _probe_ok_t < PROBE_OK_TTL_S:
+        return None   # a recent probe already proved the tunnel live
+    # close the check-then-probe TOCTOU (ADVICE r5 #2): CLAIM the busy
+    # flag with an O_EXCL create BEFORE spawning the probe, so a
+    # watcher harvest starting in the gap sees the flag held and waits
+    # instead of attaching a second axon client (which hangs both).
+    # Losing the create race means another client just took the
+    # backend — report held, exactly as if the flag had been fresh
+    # at the first check.
+    claimed = _claim_busy_flag()
+    if claimed is None:
+        return _report_held()
     try:
-        import time as _time
-        age = _time.time() - os.path.getmtime(TPU_BUSY_FLAG)
-        if age < 35 * 60:
-            print("error: the TPU backend is held by another client "
-                  "(/tmp/tpu_busy, a watcher harvest or bench run). "
-                  "Pass --platform=cpu to run on the host, or retry "
-                  "when the harvest finishes.", file=sys.stderr)
+        if _backend_probe_failed(tmo):
+            print(f"error: the default JAX backend did not initialize "
+                  f"within {tmo:.0f}s — the axon TPU tunnel is likely "
+                  f"down. Pass --platform=cpu to run on the host, or "
+                  f"set ZIRIA_BACKEND_PROBE_TIMEOUT=0 to wait "
+                  f"indefinitely.", file=sys.stderr)
             return 2
+        _probe_ok_t = time.time()
+    finally:
+        if claimed:
+            _release_busy_flag()
+    return None
+
+
+def _report_held() -> int:
+    """The one 'backend is busy, not dead' diagnostic (fresh flag and
+    lost-claim race are the same condition to the user)."""
+    print("error: the TPU backend is held by another client "
+          "(/tmp/tpu_busy, a watcher harvest or bench run). "
+          "Pass --platform=cpu to run on the host, or retry "
+          "when the harvest finishes.", file=sys.stderr)
+    return 2
+
+
+def _busy_flag_fresh() -> bool:
+    """True when TPU_BUSY_FLAG exists and is younger than the leaked-
+    flag threshold (i.e. another client genuinely holds the backend)."""
+    try:
+        return time.time() - os.path.getmtime(TPU_BUSY_FLAG) \
+            < BUSY_STALE_S
+    except OSError:
+        return False
+
+
+def _claim_busy_flag():
+    """Atomically claim TPU_BUSY_FLAG for the probe's duration.
+
+    Returns True on success, None when another client holds the flag
+    (the caller reports "held"), False when the flag path is unusable
+    (unwritable dir) — probe unguarded, the pre-fix behavior. A stale
+    leftover flag is taken over via _takeover_stale_flag (which never
+    deletes a LIVE flag) and the claim retried ONCE; a second
+    FileExistsError means a live client won the race."""
+    for attempt in (0, 1):
+        try:
+            fd = os.open(TPU_BUSY_FLAG,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, f"ziria_tpu cli probe pid={os.getpid()}\n"
+                     .encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            if attempt or _busy_flag_fresh():
+                return None
+            if not _takeover_stale_flag():
+                return None            # a live client owns it after all
+        except OSError:
+            return False
+    return False        # pragma: no cover - loop always returns
+
+
+def _takeover_stale_flag() -> bool:
+    """Remove a LEAKED busy flag without ever deleting a live one.
+
+    A bare ``unlink(path)`` here would race a concurrent takeover:
+    another client can remove the stale flag and create a FRESH one in
+    the gap after our staleness check, and our unlink would then
+    delete the live flag — exactly the double-axon-client hang the
+    claim exists to prevent. Instead: flock the EXISTING file, re-check
+    staleness on the locked fd, and unlink only while the path still
+    names that locked inode; a recreated flag has a new inode and
+    survives (we report held). Returns True when the caller may retry
+    the O_EXCL claim, False when a live holder was found."""
+    if fcntl is None:       # pragma: no cover - non-POSIX best effort
+        try:
+            os.unlink(TPU_BUSY_FLAG)
+        except OSError:
+            pass
+        return True
+    try:
+        fd = os.open(TPU_BUSY_FLAG, os.O_RDONLY)
+    except OSError:
+        return True          # already gone: retry the claim
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            return False     # another takeover in flight: treat as held
+        st = os.fstat(fd)
+        if time.time() - st.st_mtime < BUSY_STALE_S:
+            return False     # freshened while we looked: live holder
+        try:
+            if os.stat(TPU_BUSY_FLAG).st_ino != st.st_ino:
+                return False  # replaced by a new live flag
+        except OSError:
+            return True      # unlinked underneath us: retry the claim
+        os.unlink(TPU_BUSY_FLAG)
+        return True
+    finally:
+        os.close(fd)
+
+
+def _release_busy_flag() -> None:
+    try:
+        with open(TPU_BUSY_FLAG) as f:
+            if "ziria_tpu cli probe" not in f.read():
+                return       # not ours — never release another holder
+        os.unlink(TPU_BUSY_FLAG)
     except OSError:
         pass
-    if _backend_probe_failed(tmo):
-        print(f"error: the default JAX backend did not initialize "
-              f"within {tmo:.0f}s — the axon TPU tunnel is likely "
-              f"down. Pass --platform=cpu to run on the host, or set "
-              f"ZIRIA_BACKEND_PROBE_TIMEOUT=0 to wait indefinitely.",
-              file=sys.stderr)
-        return 2
-    return None
 
 
 def _run_profiled(comp, xs, args):
@@ -444,21 +575,29 @@ def main(argv=None) -> int:
     if rc is not None:
         return rc
 
-    if args.viterbi_window is None:
+    # the staged viterbi_soft ext reads the env pair at trace time
+    # (frontend/externals.viterbi_mode, folded into the backend's
+    # compile cache keys); scope the writes to this invocation so
+    # in-process callers (tests, embedders) never inherit them, and
+    # let --viterbi-window=0 / --viterbi-metric=float32 force-disable
+    # an exported env value (review r5)
+    overrides = {}
+    if args.viterbi_window is not None:
+        overrides["ZIRIA_VITERBI_WINDOW"] = str(args.viterbi_window)
+    if args.viterbi_metric is not None:
+        overrides["ZIRIA_VITERBI_METRIC"] = args.viterbi_metric
+    if not overrides:
         return _main_run(args)
-    # the staged viterbi_soft ext reads the env at trace time; scope
-    # the write to this invocation so in-process callers (tests,
-    # embedders) never inherit it, and let --viterbi-window=0
-    # force-disable an exported ZIRIA_VITERBI_WINDOW (review r5)
-    prev = os.environ.get("ZIRIA_VITERBI_WINDOW")
-    os.environ["ZIRIA_VITERBI_WINDOW"] = str(args.viterbi_window)
+    prev = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
     try:
         return _main_run(args)
     finally:
-        if prev is None:
-            os.environ.pop("ZIRIA_VITERBI_WINDOW", None)
-        else:
-            os.environ["ZIRIA_VITERBI_WINDOW"] = prev
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _main_run(args) -> int:
