@@ -6,14 +6,15 @@ compile count dropping from O(rates x log lengths) to O(log lengths).
 
 The expensive geometry compiles happen ONCE in the module fixture;
 the corpus length is chosen so every test's common symbol bucket hits
-the same compiled dispatch. Compile counts are measured as lru_cache
-DELTAS, never via cache_clear: this module runs inside the full
-suite, and clearing the shared bucketed cache would throw away
-compiled decoders later test files reuse (the per-rate/bucket entries
-are process-wide state). The exact O(rates x log lengths) -> O(log
-lengths) before/after numbers are the bench artifact's job
-(tools/rx_dispatch_bench.py, which owns clean caches in its own
-process); here the contract is the cache-growth SHAPE.
+the same compiled dispatch. Compile counts are measured with
+`utils.dispatch.cache_growth` — lru_cache DELTAS, never cache_clear:
+this module runs inside the full suite, and clearing the shared
+bucketed cache would throw away compiled decoders later test files
+reuse (the per-rate/bucket entries are process-wide state). The
+exact O(rates x log lengths) -> O(log lengths) before/after numbers
+are the bench artifact's job (tools/rx_dispatch_bench.py, which owns
+clean caches in its own process); here the contract is the
+cache-growth SHAPE.
 """
 
 import numpy as np
@@ -23,6 +24,7 @@ from ziria_tpu.backend import framebatch
 from ziria_tpu.phy.wifi import rx, tx
 from ziria_tpu.phy.wifi.params import RATES
 from ziria_tpu.utils.bits import bytes_to_bits
+from ziria_tpu.utils.dispatch import cache_growth
 
 N_BYTES = 16   # small corpus: 8-symbol common bucket keeps the
                # interpret-mode Pallas compiles inside the tier-1 budget
@@ -46,15 +48,11 @@ def corpus():
         c, w = _capture(rng, m, N_BYTES)
         caps.append(c)
         wants.append(w)
-    before_mixed = rx._jit_decode_data_mixed.cache_info().currsize
-    mixed = framebatch.receive_many(caps)
-    d_mixed = rx._jit_decode_data_mixed.cache_info().currsize \
-        - before_mixed
-    before_bucketed = rx._jit_decode_data_bucketed.cache_info().currsize
-    bucketed = [rx.receive(c) for c in caps]
-    d_bucketed = rx._jit_decode_data_bucketed.cache_info().currsize \
-        - before_bucketed
-    return (caps, wants, bucketed, mixed, d_bucketed, d_mixed)
+    with cache_growth(rx._jit_decode_data_mixed) as gm:
+        mixed = framebatch.receive_many(caps)
+    with cache_growth(rx._jit_decode_data_bucketed) as gb:
+        bucketed = [rx.receive(c) for c in caps]
+    return (caps, wants, bucketed, mixed, gb.total, gm.total)
 
 
 def test_all_8_rates_bit_identical_to_bucketed(corpus):
@@ -70,13 +68,14 @@ def test_all_8_rates_bit_identical_to_bucketed(corpus):
 def test_one_jitted_switch_serves_every_rate(corpus):
     _caps, _wants, _bucketed, _mixed, cb, cm = corpus
     # the DATA stage of the whole mixed batch is ONE compiled callable
-    # (one symbol bucket here): the mixed cache grew by exactly one
-    # entry for all 8 rates, where the bucketed path grows one entry
-    # per UNSEEN (rate, bucket) pair — up to 8 here, fewer only when
-    # an earlier test file already compiled an identical key (the
-    # shared-cache economics the mixed dispatch exists to beat)
-    assert cm == 1
-    assert 1 <= cb <= len(RATES)
+    # (one symbol bucket here): the mixed cache grew by AT MOST one
+    # entry for all 8 rates (zero when an earlier file — the batched-
+    # acquire suite shares this geometry on purpose — already built
+    # the same key), where the bucketed path grows one entry per
+    # UNSEEN (rate, bucket) pair — up to 8 here (the shared-cache
+    # economics the mixed dispatch exists to beat)
+    assert cm <= 1
+    assert cb <= len(RATES)
 
 
 def test_mixed_int16_metric_rides_the_same_dispatch(corpus):
@@ -91,10 +90,12 @@ def test_failed_lanes_keep_positions(corpus):
     # a lane that fails acquisition keeps its position and never
     # reaches the device batch. 7 live lanes pad back to the
     # fixture's 8-lane geometry, so this reuses the compiled dispatch
-    # (a fresh lane count would be a fresh — expensive — compile).
+    # (a fresh lane count would be a fresh — expensive — compile);
+    # the noise lane stays under the fixture's 1024-sample capture
+    # bucket so the batched-acquire graph is reused too.
     caps, wants, _bucketed, _mixed, _cb, _cm = corpus
     rng = np.random.default_rng(3)
-    noise = rng.normal(scale=0.01, size=(2000, 2)).astype(np.float32)
+    noise = rng.normal(scale=0.01, size=(1000, 2)).astype(np.float32)
     lanes = [caps[0], noise] + caps[2:]
     got = framebatch.receive_many(lanes)
     assert got[0].ok and not got[1].ok
@@ -114,15 +115,15 @@ def test_mixed_lengths_share_one_bucket(corpus):
     caps, wants, _bucketed, _mixed, _cb, _cm = corpus
     rng = np.random.default_rng(8)
     c54, w54 = _capture(rng, 54, 120)     # 5 syms: same 8-sym bucket
-    before = rx._jit_decode_data_mixed.cache_info().currsize
-    got = framebatch.receive_many(caps[:7] + [c54])
-    for g, (m, nb, w) in zip(
+    with cache_growth(rx._jit_decode_data_mixed) as g:
+        got = framebatch.receive_many(caps[:7] + [c54])
+    for r, (m, nb, w) in zip(
             got, [(mm, N_BYTES, ww) for mm, ww
                   in zip(sorted(RATES)[:7], wants[:7])]
             + [(54, 120, w54)]):
-        assert g.ok and g.rate_mbps == m and g.length_bytes == nb
-        np.testing.assert_array_equal(g.psdu_bits, w)
-    assert rx._jit_decode_data_mixed.cache_info().currsize == before
+        assert r.ok and r.rate_mbps == m and r.length_bytes == nb
+        np.testing.assert_array_equal(r.psdu_bits, w)
+    assert g.total == 0
 
 
 def test_rate_index_order_is_the_switch_order():

@@ -22,8 +22,15 @@ smoke or a manual chip window:
   read off the real lru_cache entry counts after clearing them, so
   the artifact records measured cache growth, not arithmetic.
 
+- ``batched_acquire_stats`` (ISSUE 2 tentpole): acquisition dispatch
+  count and wall time of ``receive_many`` with the host-driven
+  per-capture loop (>= 3N+1 dispatches) vs the one-dispatch batched
+  acquisition (acquire -> gather -> mixed decode, <= 3 dispatches),
+  measured by the instrumented utils/dispatch counter and
+  identity-gated lane for lane.
+
 Standalone: ``ZIRIA_TOOL_ALLOW_CPU=1 python tools/rx_dispatch_bench.py``
-runs both at shrunk sizes on CPU (results labelled platform=cpu,
+runs all at shrunk sizes on CPU (results labelled platform=cpu,
 never mistakable for chip evidence). Emits ONE JSON object.
 """
 
@@ -149,13 +156,17 @@ def mixed_dispatch_stats(n_bytes=100, viterbi_metric=None):
         lambda: [rx.receive(c, viterbi_metric=viterbi_metric)
                  for c in caps])
 
-    # -- after: ONE jitted lax.switch serving every rate in the batch
+    # -- after: ONE jitted lax.switch serving every rate in the batch.
+    # batched_acquire is pinned OFF so this artifact keeps measuring
+    # the mixed-dispatch lever alone, comparable with prior rounds;
+    # the acquisition before/after is batched_acquire_stats's job
     rx._jit_decode_data_mixed.cache_clear()
-    res_m = framebatch.receive_many(caps, viterbi_metric=viterbi_metric)
+    res_m = framebatch.receive_many(caps, viterbi_metric=viterbi_metric,
+                                    batched_acquire=False)
     compiles_mixed = rx._jit_decode_data_mixed.cache_info().currsize
     t_mixed = _timed(
         lambda: framebatch.receive_many(
-            caps, viterbi_metric=viterbi_metric))
+            caps, viterbi_metric=viterbi_metric, batched_acquire=False))
 
     assert all(a.ok and b.ok for a, b in zip(res_b, res_m))
     assert all(np.array_equal(a.psdu_bits, b.psdu_bits)
@@ -180,6 +191,62 @@ def mixed_dispatch_stats(n_bytes=100, viterbi_metric=None):
     }
 
 
+def batched_acquire_stats(n_bytes=100, viterbi_metric=None):
+    """Acquisition dispatch count + wall time of `receive_many` over
+    an all-8-rates corpus, host-driven per-capture acquisition vs the
+    one-dispatch batched path (acquire -> gather -> mixed decode),
+    identity-gated lane for lane. Dispatches are measured with the
+    instrumented counter (utils/dispatch.count_dispatches), so the
+    artifact records the real before/after O(N) -> O(1) collapse, not
+    arithmetic."""
+    from ziria_tpu.backend import framebatch
+    from ziria_tpu.phy.wifi import tx
+    from ziria_tpu.phy.wifi.params import RATES
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    rng = np.random.default_rng(13)
+    caps = []
+    for m in sorted(RATES):
+        psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+        s = np.asarray(tx.encode_frame(psdu, m))
+        caps.append(np.concatenate(
+            [np.zeros((50, 2), np.float32), s], axis=0))
+
+    # -- before: host loop — sync + head CFO + SIGNAL per capture,
+    #    a per-lane segment CFO, then the one mixed decode
+    with count_dispatches() as d_host:
+        res_h = framebatch.receive_many(
+            caps, viterbi_metric=viterbi_metric, batched_acquire=False)
+    t_host = _timed(lambda: framebatch.receive_many(
+        caps, viterbi_metric=viterbi_metric, batched_acquire=False))
+
+    # -- after: acquire -> gather -> decode, three dispatches total
+    with count_dispatches() as d_bat:
+        res_b = framebatch.receive_many(
+            caps, viterbi_metric=viterbi_metric, batched_acquire=True)
+    t_bat = _timed(lambda: framebatch.receive_many(
+        caps, viterbi_metric=viterbi_metric, batched_acquire=True))
+
+    assert all(a.ok and b.ok for a, b in zip(res_h, res_b))
+    assert all(np.array_equal(a.psdu_bits, b.psdu_bits)
+               for a, b in zip(res_h, res_b)), \
+        "batched acquisition diverged from the host-acquire path"
+
+    samples = sum(c.shape[0] for c in caps)
+    return {
+        "rates": len(caps), "frame_bytes": n_bytes,
+        "viterbi_metric": viterbi_metric or "float32",
+        "dispatches_host_acquire": d_host.total,
+        "dispatches_batched_acquire": d_bat.total,
+        "dispatch_breakdown_batched": dict(d_bat.counts),
+        "t_host_acquire_s": round(t_host, 4),
+        "t_batched_acquire_s": round(t_bat, 4),
+        "sps_host_acquire": round(samples / t_host, 1),
+        "sps_batched_acquire": round(samples / t_bat, 1),
+        "bit_identical": True,
+    }
+
+
 def main():
     import jax
 
@@ -196,11 +263,13 @@ def main():
     if smoke:     # shrunk sizes: prove the path, not the number
         out["quantized"] = quantized_sweep(B=8, n_bytes=100, k1=2, k2=4)
         out["mixed_dispatch"] = mixed_dispatch_stats(n_bytes=60)
+        out["batched_acquire"] = batched_acquire_stats(n_bytes=60)
     else:
         out["quantized"] = quantized_sweep()
         out["mixed_dispatch"] = mixed_dispatch_stats()
         out["mixed_dispatch_i16"] = mixed_dispatch_stats(
             viterbi_metric="int16")
+        out["batched_acquire"] = batched_acquire_stats()
     print(json.dumps(out))
     return 0
 

@@ -177,6 +177,8 @@ class StepBatcher:
                                         lambda x, i=i: x[i], rvals_b))
                 C.STATS["device_calls"] += 1
                 self.device_calls += 1
+                from ziria_tpu.utils import dispatch
+                dispatch.record("framebatch.step")
                 self.group_sizes.append(len(reqs))
             except Exception:
                 # a vmap-only failure must not abort frames whose
@@ -188,6 +190,8 @@ class StepBatcher:
                         r.result = r.node._fns[r.key](*r.args)
                         C.STATS["device_calls"] += 1
                         self.device_calls += 1
+                        from ziria_tpu.utils import dispatch
+                        dispatch.record("framebatch.step")
                         self.group_sizes.append(1)
                     except Exception as le:
                         r.exc = le
@@ -198,36 +202,56 @@ class StepBatcher:
 def receive_many(captures: Sequence[Any], check_fcs: bool = False,
                  max_samples: int = 1 << 16,
                  viterbi_window: int = None,
-                 viterbi_metric: str = None) -> List[Any]:
+                 viterbi_metric: str = None,
+                 batched_acquire: Optional[bool] = None) -> List[Any]:
     """Frame-batched library receiver: N independent captures -> N
-    :class:`rx.RxResult`s, with every decodable frame's DATA decode
-    riding ONE mixed-rate ``lax.switch`` dispatch
-    (phy/wifi/rx.decode_data_mixed) — lanes with DIFFERENT rates share
-    the same device call and the same Pallas Viterbi batch, instead of
-    fragmenting into one bucketed dispatch per rate.
+    :class:`rx.RxResult`s in O(1) device dispatches — acquire ->
+    gather -> mixed-rate decode:
 
-    Same economics as :func:`run_many`, applied to the library
-    receiver: acquisition (sync + SIGNAL parse) stays host-driven
-    per frame (fixed-shape jits, shared across lanes), then all
-    acquired frames are padded to ONE common symbol bucket and decoded
-    together; lane counts pad to the next power of two (lane 0
-    repeated) so XLA compiles O(log N) batch variants. Results are
-    bit-identical to per-capture ``rx.receive`` lane for lane.
+    1. **acquire** (`rx.acquire_many`): STS detect, LTS peak-pick,
+       CFO, on-device alignment, and SIGNAL decode for ALL lanes as
+       ONE vmapped dispatch; the host does only the integer header
+       parsing and the symbol-bucket choice.
+    2. **gather** (`rx.gather_segments_many`): every decodable lane's
+       data region sliced at its own offset and derotated by its own
+       CFO phase at ONE common symbol bucket — one dispatch, output
+       device-resident.
+    3. **decode** (`rx.decode_data_mixed`): the one-``lax.switch``
+       mixed-rate DATA decode — lanes with DIFFERENT rates share the
+       same device call and the same Pallas Viterbi batch.
+
+    ``batched_acquire=False`` (or env ``ZIRIA_BATCHED_ACQUIRE=0``)
+    falls back to the host-driven per-capture acquisition loop (~3
+    round trips per capture — the pre-batched oracle). Either way,
+    results are bit-identical to per-capture ``rx.receive`` lane for
+    lane, including no-detect / bad-parity / truncated lanes; lane
+    counts pad to the next power of two (lane 0 repeated) so XLA
+    compiles O(log N) batch variants.
     """
+    import os
+
     import jax.numpy as jnp
 
     from ziria_tpu.ops.crc import check_crc32
     from ziria_tpu.phy.wifi import rx as _rx
     from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
+    from ziria_tpu.utils import dispatch
+
+    if batched_acquire is None:
+        batched_acquire = os.environ.get(
+            "ZIRIA_BATCHED_ACQUIRE", "1") != "0"
 
     results: List[Any] = [None] * len(captures)
-    acqs = []
-    for i, s in enumerate(captures):
-        res, acq = _rx._acquire_frame(s, max_samples)
-        if acq is None:
-            results[i] = res
-        else:
-            acqs.append((i, acq))
+    if batched_acquire:
+        results, x_dev, acqs = _rx.acquire_many(captures, max_samples)
+    else:
+        acqs = []
+        for i, s in enumerate(captures):
+            res, acq = _rx._acquire_frame(s, max_samples)
+            if acq is None:
+                results[i] = res
+            else:
+                acqs.append((i, acq))
     if not acqs:
         return results
 
@@ -237,8 +261,12 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
     n_sym_b = max(_rx._sym_bucket(a.n_sym) for _i, a in acqs)
     lanes = len(acqs)
     padded = acqs + [acqs[0]] * (_pow2(lanes) - lanes)
-    segs = jnp.stack([_rx._padded_segment(a, n_sym_b)
-                      for _i, a in padded])
+    if batched_acquire:
+        segs = _rx.gather_segments_many(
+            x_dev, [a for _i, a in padded], n_sym_b)
+    else:
+        segs = jnp.stack([_rx._padded_segment(a, n_sym_b)
+                          for _i, a in padded])
     ridx = jnp.asarray([_rx.RATE_INDEX[a.rate_mbps] for _i, a in padded],
                        jnp.int32)
     nbits = jnp.asarray(
@@ -246,6 +274,7 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
         jnp.int32)
     dec = _rx._jit_decode_data_mixed(n_sym_b, viterbi_window,
                                      viterbi_metric)
+    dispatch.record("rx.decode_mixed")
     clear = np.asarray(dec(segs, ridx, nbits), np.uint8)
     for k, (i, a) in enumerate(acqs):
         psdu = clear[k][N_SERVICE_BITS: N_SERVICE_BITS

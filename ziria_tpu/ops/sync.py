@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ziria_tpu.ops import cplx
-from ziria_tpu.ops.ofdm import LTS_FREQ, N_FFT
+from ziria_tpu.ops.ofdm import LTS_FREQ, N_FFT, lts_time_symbol
 
 
 def _sliding_sum(x, w: int):
@@ -64,13 +64,23 @@ def sts_autocorr(samples, window: int = 48):
     return metric, corr
 
 
-def detect_packet(samples, window: int = 48, threshold: float = 0.75):
+def detect_packet(samples, window: int = 48, threshold: float = 0.75,
+                  limit=None):
     """Return (detected?, start_index) — the first index where the STS
     autocorrelation metric crosses the threshold (start of the plateau).
     Data-dependent only in the returned index, so it jits (lax-friendly
-    argmax over a boolean ramp)."""
+    argmax over a boolean ramp).
+
+    ``limit`` (static or traced) caps the considered positions to
+    those a LIMIT-length capture would evaluate — see
+    :func:`locate_frame`, the one caller that needs it. This is THE
+    detection gate: `locate_frame` delegates here, so the threshold/
+    window defaults live in exactly one place."""
     metric, _ = sts_autocorr(samples, window)
     above = metric > threshold
+    if limit is not None:
+        above = above \
+            & (jnp.arange(above.shape[0]) < limit - 16 - window + 1)
     detected = jnp.any(above)
     start = jnp.argmax(above).astype(jnp.int32)  # first True
     return detected, start
@@ -102,6 +112,73 @@ def correct_cfo(samples, eps):
     n = jnp.arange(x.shape[0], dtype=jnp.float32)
     rot = cplx.cexp(-eps * n)
     return cplx.cmul(x, rot)
+
+
+def locate_frame(samples, limit=None, window: int = 48,
+                 threshold: float = 0.75):
+    """Locate and align a frame in a sample stream: STS detection
+    gate, LTS cross-correlation timing, coarse+fine CFO. Returns
+    (found, frame_start_index, cfo_estimate).
+
+    Whole-array ops at fixed shapes, data-dependent only in *values*
+    (argmax index, dynamic_slice at the traced start), so it jits —
+    and, crucially for the one-dispatch batched acquisition
+    (phy/wifi/rx.acquire_many), it runs under ``vmap``: N captures'
+    detects, peak-picks, and CFO estimates become ONE batched graph.
+
+    ``limit`` (static or traced, default: the full length) caps the
+    positions the detection gate and the peak-pick consider to those
+    a LIMIT-length capture would evaluate. Values at positions below
+    the cap depend only on their local window, so trailing zero
+    padding never changes them — but a LONGER array also has MORE
+    positions, whose windows can overlap the capture's last real
+    samples. The batched acquisition pads every lane to one COMMON
+    bucket, so each lane passes its OWN power-of-two bucket as
+    ``limit`` and its detect/argmax stay bit-identical to the
+    per-capture path padded to that bucket.
+    """
+    import jax
+
+    x = jnp.asarray(samples, jnp.float32)
+    n = x.shape[0]
+    lim = n if limit is None else limit
+
+    # STS detection gate (the coarse start is superseded by the LTS
+    # timing below)
+    detected, _coarse = detect_packet(x, window, threshold, limit=limit)
+
+    # LTS timing: cross-correlate with the known long symbol; the two
+    # LTS peaks are 64 apart; first LTS starts at frame_start + 192
+    lts = jnp.asarray(lts_time_symbol())                # (64, 2)
+
+    def xcorr(sig):
+        # correlation of sig against lts at all lags (valid region)
+        ref = cplx.conj(lts)[::-1]                      # reversed conj
+
+        def conv1(u, v):
+            return jnp.convolve(u, v, precision="highest")
+
+        re = conv1(sig[:, 0], ref[:, 0]) - conv1(sig[:, 1], ref[:, 1])
+        im = conv1(sig[:, 0], ref[:, 1]) + conv1(sig[:, 1], ref[:, 0])
+        # full conv index 63+k = correlation at lag k
+        return (re[63:n] ** 2 + im[63:n] ** 2)
+
+    c = xcorr(x)                                        # (n-63,)
+    pair = c[:-64] + c[64:]                             # two-peak sum
+    # cap the peak-pick the same way (pair values are >= 0, so -1
+    # sentinels can never win argmax while any in-cap position exists)
+    pair = jnp.where(jnp.arange(pair.shape[0]) < lim - 127, pair, -1.0)
+    lts1 = jnp.argmax(pair).astype(jnp.int32)
+    frame_start = jnp.maximum(lts1 - 192, 0)
+
+    # CFO from the aligned preamble: coarse (lag-16 STS, wide range)
+    # then fine (lag-64 LTS, 4x resolution) on the coarse-corrected
+    # head
+    frame_head = jax.lax.dynamic_slice(x, (frame_start, 0), (320, 2))
+    eps_c = estimate_cfo_sts(frame_head)
+    head2 = correct_cfo(frame_head, eps_c)
+    eps_f = estimate_cfo_lts(head2)
+    return detected, frame_start, eps_c + eps_f
 
 
 def estimate_channel(samples):

@@ -150,39 +150,12 @@ def decode_data_batch(frames, rate: RateParams, n_sym: int,
 def sync_frame(samples):
     """Locate and align a frame in a sample stream: STS detection gate,
     LTS cross-correlation timing, coarse+fine CFO. Returns
-    (found, frame_start_index, cfo_estimate). Fixed shapes -> jits."""
-    x = jnp.asarray(samples, jnp.float32)
-    detected, coarse_start = sync.detect_packet(x)
+    (found, frame_start_index, cfo_estimate). Fixed shapes -> jits.
 
-    # LTS timing: cross-correlate with the known long symbol; the two
-    # LTS peaks are 64 apart; first LTS starts at frame_start + 192
-    lts = jnp.asarray(ofdm.lts_time_symbol())           # (64, 2)
-    n = x.shape[0]
-
-    def xcorr(sig):
-        # correlation of sig against lts at all lags (valid region)
-        ref = cplx.conj(lts)[::-1]                      # reversed conj
-
-        def conv1(u, v):
-            return jnp.convolve(u, v, precision="highest")
-
-        re = conv1(sig[:, 0], ref[:, 0]) - conv1(sig[:, 1], ref[:, 1])
-        im = conv1(sig[:, 0], ref[:, 1]) + conv1(sig[:, 1], ref[:, 0])
-        # full conv index 63+k = correlation at lag k
-        return (re[63:n] ** 2 + im[63:n] ** 2)
-
-    c = xcorr(x)                                        # (n-63,)
-    pair = c[:-64] + c[64:]                             # two-peak sum
-    lts1 = jnp.argmax(pair).astype(jnp.int32)
-    frame_start = jnp.maximum(lts1 - 192, 0)
-
-    # CFO from the aligned preamble: coarse (lag-16 STS, wide range) then
-    # fine (lag-64 LTS, 4x resolution) on the coarse-corrected head
-    frame_head = jax.lax.dynamic_slice(x, (frame_start, 0), (320, 2))
-    eps_c = sync.estimate_cfo_sts(frame_head)
-    head2 = sync.correct_cfo(frame_head, eps_c)
-    eps_f = sync.estimate_cfo_lts(head2)
-    return detected, frame_start, eps_c + eps_f
+    The graph itself lives in ``ops/sync.locate_frame`` (vmap-ready so
+    ``acquire_many`` can batch it); this name is the receiver-side
+    oracle entry the per-capture path and tests use."""
+    return sync.locate_frame(samples)
 
 
 class RxResult(NamedTuple):
@@ -338,8 +311,30 @@ def _jit_decode_data_mixed(n_sym_bucket: int, viterbi_window: int = None,
     return jax.jit(f)
 
 
-_jit_sync = None
-_jit_signal = None
+# ------------------------------------------------------ frame acquisition
+#
+# Two structurally-identical paths share one decision tree:
+#  - `_acquire_frame`: the per-capture oracle (host-driven, 2 fixed-
+#    shape jits + one eager CFO rotation per capture);
+#  - `acquire_many`: the whole front end for N captures as ONE vmapped
+#    dispatch (`acquire_frame_graph` under vmap), the host reduced to
+#    integer header parsing between dispatches.
+# Lane-for-lane bit-identity between them is the pinned contract
+# (tests/test_rx_batched_acquire.py).
+
+
+@lru_cache(maxsize=None)
+def _jit_sync_fn():
+    """jit(sync_frame), built once. `lru_cache` (not a checked global)
+    so concurrent first calls from `framebatch` worker threads can
+    never observe a half-initialized pair; a racing duplicate build is
+    harmless — one value wins the cache and both are valid."""
+    return jax.jit(sync_frame)
+
+
+@lru_cache(maxsize=None)
+def _jit_signal_fn():
+    return jax.jit(decode_signal)
 
 
 class _Acquired(NamedTuple):
@@ -352,68 +347,262 @@ class _Acquired(NamedTuple):
     n_sym: int
 
 
-def _acquire_frame(samples, max_samples: int = 1 << 16):
-    """Detect/align/CFO-correct a capture and parse its SIGNAL field:
-    the shared acquisition front of `receive` and the frame-batched
-    `backend.framebatch.receive_many`. Returns (RxResult, None) on any
-    failure, (None, _Acquired) on success."""
-    global _jit_sync, _jit_signal
-    if _jit_sync is None:
-        _jit_sync = jax.jit(sync_frame)
-        _jit_signal = jax.jit(
-            lambda fr: decode_signal(fr))
+def _stream_bucket(n: int) -> int:
+    """Power-of-two capture bucket (min 512): the ONE padding formula
+    the per-capture and batched acquisition paths share — their
+    bit-identity contract assumes identical padded geometry rules."""
+    return 1 << max(9, (n - 1).bit_length())
 
-    fail = RxResult(False, 0, 0, np.zeros(0, np.uint8), None)
-    x = np.asarray(samples, np.float32)[:max_samples]
-    n_valid = x.shape[0]  # true capture length, before bucket padding
-    # pad to a power-of-two bucket so the sync jit compiles once per
-    # bucket, not once per stream length (zeros are inert to detection)
-    bucket = 1 << max(9, (n_valid - 1).bit_length())
+
+def _bucket_pad(x: np.ndarray):
+    """Pad a capture to its power-of-two bucket so the sync/acquire
+    jits compile once per bucket, not once per stream length (zeros
+    are inert to detection). Returns (padded, n_valid)."""
+    n_valid = x.shape[0]
+    bucket = _stream_bucket(n_valid)
     if bucket != n_valid:
         x = np.concatenate(
             [x, np.zeros((bucket - n_valid, 2), np.float32)], axis=0)
-    found, start, eps = _jit_sync(x)
-    if not bool(np.asarray(found)):
-        return fail, None
-    start = int(np.asarray(start))
-    eps = float(np.asarray(eps))
+    return x, n_valid
 
-    # all length checks use the true capture length — decoding padding
-    # zeros as DATA must fail, not silently "succeed"
-    frame_np = x[start:]
-    avail = n_valid - start
-    if avail < 400:
+
+def _classify_acquire(found: bool, avail: int, rate_bits: int,
+                      length_bytes: int, parity_ok: bool):
+    """The shared host decision tree over acquisition outputs — all
+    integer/bool parsing, no device work. Returns (RxResult, None) on
+    any failure, (None, (rate_mbps, n_sym)) for a decodable frame.
+
+    All length checks use the true capture length — decoding padding
+    zeros as DATA must fail, not silently "succeed"."""
+    fail = RxResult(False, 0, 0, np.zeros(0, np.uint8), None)
+    if not found or avail < 400 or not parity_ok:
         return fail, None
-    # CFO-correct only fixed-size regions so device code caches: the
-    # 400-sample head now, the (rate, n_sym)-sized data region after the
-    # SIGNAL parse (both slices start at the frame start, keeping the
-    # rotation phase-continuous)
-    head = sync.correct_cfo(jnp.asarray(frame_np[:400]), eps)
-    rate_bits, length, parity_ok = _jit_signal(head)
-    if not bool(np.asarray(parity_ok)):
-        return fail, None
-    rate_mbps = SIGNAL_BITS_TO_MBPS.get(int(np.asarray(rate_bits)))
+    rate_mbps = SIGNAL_BITS_TO_MBPS.get(rate_bits)
     if rate_mbps is None:
         return fail, None
-    length_bytes = int(np.asarray(length))
-    rate = RATES[rate_mbps]
-    n_sym = n_symbols(length_bytes, rate)
-    need = FRAME_DATA_START + 80 * n_sym
-    if avail < need:
+    n_sym = n_symbols(length_bytes, RATES[rate_mbps])
+    if avail < FRAME_DATA_START + 80 * n_sym:
         return RxResult(False, rate_mbps, length_bytes,
                         np.zeros(0, np.uint8), None), None
-    return None, _Acquired(frame_np, avail, eps, rate_mbps,
+    return None, (rate_mbps, n_sym)
+
+
+def _acquire_frame(samples, max_samples: int = 1 << 16):
+    """Detect/align/CFO-correct a capture and parse its SIGNAL field:
+    the per-capture acquisition front of `receive` — and the single-
+    lane oracle of the batched `acquire_many`. Returns (RxResult,
+    None) on any failure, (None, _Acquired) on success."""
+    from ziria_tpu.utils import dispatch
+
+    x, n_valid = _bucket_pad(
+        np.asarray(samples, np.float32)[:max_samples])
+    dispatch.record("rx.sync")
+    found, start, eps = _jit_sync_fn()(x)
+    found = bool(np.asarray(found))
+    start = int(np.asarray(start))
+    eps = float(np.asarray(eps))
+    avail = n_valid - start
+    rate_bits = length_bytes = 0
+    parity_ok = False
+    if found and avail >= 400:
+        # CFO-correct only fixed-size regions so device code caches:
+        # the 400-sample head now, the (rate, n_sym)-sized data region
+        # after the SIGNAL parse (both slices start at the frame
+        # start, keeping the rotation phase-continuous)
+        dispatch.record("rx.cfo_head")
+        head = sync.correct_cfo(jnp.asarray(x[start:start + 400]), eps)
+        dispatch.record("rx.signal")
+        rb, ln, pk = _jit_signal_fn()(head)
+        rate_bits = int(np.asarray(rb))
+        length_bytes = int(np.asarray(ln))
+        parity_ok = bool(np.asarray(pk))
+    res, ok = _classify_acquire(found, avail, rate_bits, length_bytes,
+                                parity_ok)
+    if ok is None:
+        return res, None
+    rate_mbps, n_sym = ok
+    return None, _Acquired(x[start:], avail, eps, rate_mbps,
                            length_bytes, n_sym)
+
+
+def acquire_frame_graph(x, n_valid, limit):
+    """Fully-traceable single-capture acquisition: STS detect, LTS
+    peak-pick, coarse+fine CFO, on-device frame alignment
+    (`lax.dynamic_slice` at the traced start), CFO rotation of the
+    400-sample head, and the SIGNAL decode — fused into ONE graph.
+
+    x: (L, 2) bucket-padded capture; n_valid: true capture length
+    (traced int32); limit: the lane's OWN power-of-two bucket (traced
+    int32) — caps detection/peak-pick positions so a lane padded past
+    its own bucket to the batch's common one evaluates exactly the
+    positions the per-capture path does (sync.locate_frame). Returns
+    per-lane (found, start, eps, rate_bits, length, parity_ok) —
+    `found` already folds in the >= 400-sample availability gate, so
+    every downstream field of a not-found lane is garbage-by-
+    construction and masked by the host decision tree. Under `vmap`
+    this is the whole acquisition front end of a batch in one
+    dispatch."""
+    detected, start, eps = sync.locate_frame(x, limit=limit)
+    avail = n_valid - start
+    head = jax.lax.dynamic_slice(x, (start, jnp.int32(0)), (400, 2))
+    head = sync.correct_cfo(head, eps)
+    rate_bits, length, parity_ok = decode_signal(head)
+    found = jnp.logical_and(detected, avail >= 400)
+    return found, start, eps, rate_bits, length, parity_ok
+
+
+@lru_cache(maxsize=None)
+def _jit_acquire_many():
+    """ONE jitted vmap of the acquisition graph serves every
+    (lane count, bucket) geometry (jit retraces per shape)."""
+    return jax.jit(jax.vmap(acquire_frame_graph))
+
+
+class _LaneAcq(NamedTuple):
+    """A decodable lane of a batched acquisition: everything the
+    gather+decode dispatches need, as host integers/floats."""
+    row: int                    # row in the padded capture batch
+    start: int
+    eps: float
+    avail: int
+    rate_mbps: int
+    length_bytes: int
+    n_sym: int
+
+
+def acquire_many(captures, max_samples: int = 1 << 16):
+    """Batched acquisition front end: N captures -> per-lane
+    (found, start, eps, rate_bits, length, parity_ok) in ONE device
+    dispatch, then the host decision tree (integer parsing only).
+
+    Returns (results, x_dev, lanes): `results[i]` is the failure
+    RxResult for undecodable lanes and None for decodable ones,
+    `x_dev` is the (N_pow2, L, 2) bucket-padded capture batch as the
+    DEVICE array the acquire dispatch already uploaded (kept resident
+    so the gather dispatch slices data regions without a second trip
+    through the host link), `lanes` is [(i, _LaneAcq)] for the
+    decodable lanes. Lane-for-lane, the classification and every
+    parsed field are bit-identical to per-capture `_acquire_frame`."""
+    from ziria_tpu.utils import dispatch
+
+    if not len(captures):
+        return [], jnp.zeros((0, 0, 2), jnp.float32), []
+    xs = [np.asarray(s, np.float32)[:max_samples] for s in captures]
+    n_valid = np.asarray([x.shape[0] for x in xs], np.int32)
+    # ONE common bucket for the whole batch (zeros are inert to the
+    # detector and to the conv outputs at real-sample positions, so a
+    # longer pad does not change any lane's values), and lane counts
+    # pad to a power of two (lane 0 repeated) so XLA compiles O(log N)
+    # batch variants
+    bucket = _stream_bucket(int(n_valid.max()))
+    n_lanes = len(xs)
+    n_rows = 1 << max(0, (n_lanes - 1).bit_length())
+    x_pad = np.zeros((n_rows, bucket, 2), np.float32)
+    for i, x in enumerate(xs):
+        x_pad[i, :x.shape[0]] = x
+    if n_lanes < n_rows:
+        x_pad[n_lanes:] = x_pad[0]
+    nv_pad = np.full((n_rows,), n_valid[0], np.int32)
+    nv_pad[:n_lanes] = n_valid
+    # each lane's OWN bucket caps its detect/peak-pick positions so
+    # sharing a longer common bucket cannot expose tail windows the
+    # per-capture path never evaluates (sync.locate_frame's limit)
+    limits = np.asarray([_stream_bucket(int(v)) for v in nv_pad],
+                        np.int32)
+
+    dispatch.record("rx.acquire_many")
+    x_dev = jnp.asarray(x_pad)
+    found_b, start_b, eps_b, rb_b, ln_b, pk_b = _jit_acquire_many()(
+        x_dev, jnp.asarray(nv_pad), jnp.asarray(limits))
+    found_b = np.asarray(found_b)
+    start_b = np.asarray(start_b)
+    eps_b = np.asarray(eps_b)
+    rb_b = np.asarray(rb_b)
+    ln_b = np.asarray(ln_b)
+    pk_b = np.asarray(pk_b)
+
+    results = [None] * n_lanes
+    lanes = []
+    for i in range(n_lanes):
+        start = int(start_b[i])
+        avail = int(n_valid[i]) - start
+        res, ok = _classify_acquire(bool(found_b[i]), avail,
+                                    int(rb_b[i]), int(ln_b[i]),
+                                    bool(pk_b[i]))
+        if ok is None:
+            results[i] = res
+            continue
+        rate_mbps, n_sym = ok
+        lanes.append((i, _LaneAcq(i, start, float(eps_b[i]), avail,
+                                  rate_mbps, int(ln_b[i]), n_sym)))
+    return results, x_dev, lanes
+
+
+def gather_segment_graph(x, start, eps, avail, n_sym_bucket: int):
+    """One lane of the batched "gather+derotate" graph: slice the
+    frame region at the lane's own (traced) start, zero everything
+    past its true available samples, and apply its own CFO phase —
+    the traced twin of `_padded_segment`, fused for the whole batch
+    under vmap. `x` must be padded so start + need_b never clamps."""
+    need_b = FRAME_DATA_START + 80 * n_sym_bucket
+    seg = jax.lax.dynamic_slice(x, (start, jnp.int32(0)), (need_b, 2))
+    n = jnp.minimum(avail, need_b)
+    seg = jnp.where((jnp.arange(need_b) < n)[:, None], seg, 0.0)
+    return sync.correct_cfo(seg, eps)
+
+
+@lru_cache(maxsize=None)
+def _jit_gather_segments(n_sym_bucket: int):
+    """ONE jitted gather per symbol bucket (shapes retrace per
+    (lane count, capture bucket) pair). The row gather and the tail
+    pad both happen INSIDE the jit, on the device-resident capture
+    batch the acquire dispatch uploaded — the batch never crosses the
+    host link a second time."""
+    need_b = FRAME_DATA_START + 80 * n_sym_bucket
+
+    def f(x_all, rows, start, eps, avail):
+        # tail-pad so start + need_b is always in bounds:
+        # dynamic_slice clamps out-of-range starts, which would
+        # silently shift a lane
+        x = jnp.pad(x_all[rows], ((0, 0), (0, need_b), (0, 0)))
+        return jax.vmap(
+            lambda xi, s, e, a: gather_segment_graph(
+                xi, s, e, a, n_sym_bucket))(x, start, eps, avail)
+
+    return jax.jit(f)
+
+
+def gather_segments_many(x_dev, lanes, n_sym_bucket: int):
+    """Slice every decodable lane's data region at its own offset and
+    apply its own CFO rotation at the common symbol bucket — ONE
+    device dispatch over the device-resident capture batch from
+    `acquire_many`; output stays on device for the mixed-rate decode.
+    `lanes` rows must already be padded to the target lane count
+    (repeat the first entry, like every batch path here)."""
+    from ziria_tpu.utils import dispatch
+
+    dispatch.record("rx.gather")
+    return _jit_gather_segments(n_sym_bucket)(
+        x_dev,
+        jnp.asarray([la.row for la in lanes], jnp.int32),
+        jnp.asarray([la.start for la in lanes], jnp.int32),
+        jnp.asarray([la.eps for la in lanes], jnp.float32),
+        jnp.asarray([la.avail for la in lanes], jnp.int32))
 
 
 def _padded_segment(acq: _Acquired, n_sym_bucket: int):
     """The acquired frame's data region padded to `n_sym_bucket`
     symbols and CFO-corrected: the fixed-geometry device input of the
-    bucketed and mixed-rate DATA decodes."""
+    bucketed and mixed-rate DATA decodes. Per-lane host path — the
+    batched `gather_segments_many` produces the identical values for
+    a whole batch in one dispatch."""
+    from ziria_tpu.utils import dispatch
+
     need_b = FRAME_DATA_START + 80 * n_sym_bucket
     frame_pad = np.zeros((need_b, 2), np.float32)
     n = min(acq.avail, need_b)
     frame_pad[:n] = acq.frame_np[:n]
+    dispatch.record("rx.cfo_segment")
     return sync.correct_cfo(jnp.asarray(frame_pad), acq.eps)
 
 
@@ -462,6 +651,8 @@ def receive(samples, check_fcs: bool = False,
     dec = _jit_decode_data_bucketed(acq.rate_mbps, n_sym_b, fxp,
                                     None if fxp else viterbi_window,
                                     None if fxp else viterbi_metric)
+    from ziria_tpu.utils import dispatch
+    dispatch.record("rx.decode_bucketed")
     clear = np.asarray(
         dec(seg, jnp.int32(acq.n_sym * rate.n_dbps)), np.uint8)
     psdu = clear[N_SERVICE_BITS: N_SERVICE_BITS + 8 * acq.length_bytes]

@@ -246,6 +246,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "footprint; docs/quantized_viterbi.md), "
                         "float32 the exact oracle (default); also via "
                         "ZIRIA_VITERBI_METRIC")
+    p.add_argument("--batched-acquire", dest="batched_acquire",
+                   action="store_true", default=None,
+                   help="one-dispatch batched acquisition for the "
+                        "frame-batched library receiver "
+                        "(framebatch.receive_many): detect + align + "
+                        "CFO + SIGNAL parse for ALL captures as ONE "
+                        "vmapped device call, then gather+derotate "
+                        "and the mixed-rate decode — O(1) dispatches "
+                        "per batch instead of ~3 per capture (the "
+                        "default; docs/architecture.md). Also via "
+                        "ZIRIA_BATCHED_ACQUIRE=1")
+    p.add_argument("--no-batched-acquire", dest="batched_acquire",
+                   action="store_false",
+                   help="force the host-driven per-capture "
+                        "acquisition loop (the batched path's "
+                        "bit-identical oracle); also via "
+                        "ZIRIA_BATCHED_ACQUIRE=0")
     return p
 
 
@@ -586,6 +603,12 @@ def main(argv=None) -> int:
         overrides["ZIRIA_VITERBI_WINDOW"] = str(args.viterbi_window)
     if args.viterbi_metric is not None:
         overrides["ZIRIA_VITERBI_METRIC"] = args.viterbi_metric
+    if args.batched_acquire is not None:
+        # receive_many reads this at call time; scoping the write
+        # keeps in-process callers from inheriting the flag, same as
+        # the viterbi pair above
+        overrides["ZIRIA_BATCHED_ACQUIRE"] = \
+            "1" if args.batched_acquire else "0"
     if not overrides:
         return _main_run(args)
     prev = {k: os.environ.get(k) for k in overrides}

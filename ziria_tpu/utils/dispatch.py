@@ -1,0 +1,117 @@
+"""Device-dispatch observability: count the compiled calls a code
+path fires, and the compile-cache growth it causes.
+
+The frame-batching work lives and dies by TWO integers the profiler
+does not hand you: how many *device dispatches* a receive path costs
+(each one pays the host link round trip — the ~68 ms tax BENCH_r05
+measured through the axon tunnel) and how many *fresh compiles* it
+triggered (tens of seconds each on first contact). This module gives
+both a first-class seam:
+
+- :func:`count_dispatches` — a context manager; every instrumented
+  call site inside the ``with`` block increments a labelled counter.
+  Sites are instrumented explicitly with :func:`record` (the same
+  own-call-site discipline as ``backend.chunked.STATS`` — JAX has no
+  stable public hook for "a compiled program ran", so we count where
+  WE launch device work; eager jnp call sites count as one dispatch
+  however many primitives they fan into, making every reported bound
+  a LOWER bound on real device calls).
+- :func:`cache_growth` — lru-delta measurement for the jit-factory
+  caches (``rx._jit_decode_data_mixed`` etc.): the compile-count
+  proxy `tests/test_rx_mixed_dispatch.py` used to hand-roll. Deltas,
+  never ``cache_clear`` — the caches are process-wide shared state.
+
+Both are reentrant and thread-safe: nested/overlapping counters each
+see every event recorded while they are active (frame threads under
+``framebatch.run_many`` all report into the same active counters).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from contextlib import contextmanager
+from typing import Dict, List, Tuple
+
+_LOCK = threading.Lock()
+_ACTIVE: List["DispatchCount"] = []
+
+
+class DispatchCount:
+    """Labelled dispatch tally filled in by :func:`record` while its
+    :func:`count_dispatches` block is active."""
+
+    def __init__(self) -> None:
+        self.counts: Counter = Counter()
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        inner = ", ".join(f"{k}={v}" for k, v in sorted(
+            self.counts.items()))
+        return f"DispatchCount(total={self.total}, {inner})"
+
+
+def record(label: str = "dispatch", n: int = 1) -> None:
+    """Report ``n`` device dispatches at an instrumented call site.
+
+    Free when no counter is active (one lock-free len check), so the
+    hot paths carry their instrumentation permanently.
+    """
+    if not _ACTIVE:
+        return
+    with _LOCK:
+        for c in _ACTIVE:
+            c.counts[label] += n
+
+
+@contextmanager
+def count_dispatches():
+    """``with count_dispatches() as d:`` — afterwards ``d.total`` is
+    the number of instrumented device dispatches the block performed
+    and ``d.counts`` the per-label breakdown."""
+    c = DispatchCount()
+    with _LOCK:
+        _ACTIVE.append(c)
+    try:
+        yield c
+    finally:
+        with _LOCK:
+            _ACTIVE.remove(c)
+
+
+class CacheGrowth:
+    """Per-cache ``currsize`` deltas captured on context exit."""
+
+    def __init__(self, caches: Tuple) -> None:
+        self._caches = caches
+        self._before = [c.cache_info().currsize for c in caches]
+        self.growth: Dict = {}
+
+    def _finish(self) -> None:
+        self.growth = {
+            c: c.cache_info().currsize - b
+            for c, b in zip(self._caches, self._before)}
+
+    @property
+    def total(self) -> int:
+        return sum(self.growth.values())
+
+    def __getitem__(self, cache) -> int:
+        return self.growth[cache]
+
+
+@contextmanager
+def cache_growth(*caches):
+    """``with cache_growth(rx._jit_decode_data_mixed) as g:`` — after
+    the block, ``g[cache]`` / ``g.total`` give how many NEW entries
+    (fresh compiled callables) the block added to each ``lru_cache``.
+    Measures deltas without ever clearing: safe inside a shared-cache
+    process (a full pytest run, an embedder)."""
+    g = CacheGrowth(caches)
+    try:
+        yield g
+    finally:
+        g._finish()
