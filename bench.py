@@ -1094,6 +1094,33 @@ def _child_main(run_id):
             note(f"batched acquire stage failed: {e!r}")
             acq_ev = {"error": repr(e)}
 
+    # ISSUE 3 tentpole evidence: the closed TX -> channel -> RX
+    # loopback's dispatch collapse (per-frame >= 5N vs batched <= 5)
+    # and frames/s, measured by the instrumented counter through the
+    # shared tools module. Same resumable, never-fatal discipline.
+    def _link_loopback_stage():
+        if time.time() - t0 > 0.96 * budget:
+            raise TimeoutError("skipped: child time budget")
+        ev = _load_rx_dispatch_bench().link_loopback_stats(
+            n_bytes=24 if os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+            else 100)
+        note(f"link loopback: {ev['dispatches_perframe']} dispatches / "
+             f"{ev['fps_perframe']:.1f} fps -> "
+             f"{ev['dispatches_batched']} dispatches / "
+             f"{ev['fps_batched']:.1f} fps")
+        part("link_loopback", **ev)
+        return ev
+
+    if "link_loopback" in resume:
+        link_ev = reuse(resume["link_loopback"])
+        note("link loopback resumed from prior window")
+    else:
+        try:
+            link_ev = _link_loopback_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"link loopback stage failed: {e!r}")
+            link_ev = {"error": repr(e)}
+
     def _percall_fence_stage():
         # per-call diagnostic (tunnel-dispatch-bound upper bound on
         # latency) — always taken at the base batch of 128, which may
@@ -1160,6 +1187,7 @@ def _child_main(run_id):
         "quantized_viterbi": quant_ev,
         "mixed_dispatch": mixed_ev,
         "batched_acquire": acq_ev,
+        "link_loopback": link_ev,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
         "resumed_stages": sorted(set(resumed_stages)),
     }

@@ -7,39 +7,59 @@ equal SNR, and soft-decision decoding shows real coding gain over the
 theoretical UNCODED channel-bit error rate.
 
 Setup is the standard BER-sim isolation: perfect timing/CFO (frames
-from tx.encode_frame + AWGN only), rate forced — measuring the
+from the batched TX + AWGN only), rate forced — measuring the
 equalize/demap/deinterleave/Viterbi/descramble chain, not packet
 detection (detection robustness is exercised by the golden captures'
 impairments).
+
+The measurement rides the batched loopback's statistical lane
+(phy/link.loopback_ber_bits): frames encode in ONE device dispatch
+instead of N host-driven per-frame encodes — the same BERs (the TX
+batch is bit-identical lane for lane, the AWGN keys identical), a
+fraction of the tier-1 wall time. The pre-batched per-frame path is
+kept as the `slow` oracle lane, pinned EQUAL to the batched one.
 """
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ziria_tpu.phy import channel
-from ziria_tpu.phy.wifi import rx, tx
-from ziria_tpu.phy.wifi.params import RATES, n_symbols
+from ziria_tpu.phy import link
+from ziria_tpu.phy.wifi.params import RATES
 from ziria_tpu.utils.bits import bytes_to_bits
 
 N_FRAMES = 16
 N_BYTES = 100
 
 
-def _ber_at(mbps: int, snr_db: float, seed: int) -> float:
-    rate = RATES[mbps]
-    n_sym = n_symbols(N_BYTES, rate)
-    rng = np.random.default_rng(seed)
-    psdus = rng.integers(0, 256, (N_FRAMES, N_BYTES)).astype(np.uint8)
-    frames = jnp.stack([tx.encode_frame(p, mbps) for p in psdus])
-    key = jax.random.PRNGKey(seed)
-    noisy = jax.vmap(
-        lambda k, f: channel.awgn(k, f, snr_db))(
-            jax.random.split(key, N_FRAMES), frames)
-    got, _ = rx.decode_data_batch(noisy, rate, n_sym, 8 * N_BYTES)
-    want = np.stack([np.asarray(bytes_to_bits(p)) for p in psdus])
-    return float(np.mean(np.asarray(got) != want))
+def _psdus(seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(
+        0, 256, (N_FRAMES, N_BYTES)).astype(np.uint8)
+
+
+def _ber_from_bits(got: np.ndarray, psdus: np.ndarray) -> float:
+    want = np.stack([np.asarray(bytes_to_bits(p, xp=np)) for p in psdus])
+    return float(np.mean(got != want))
+
+
+def _ber_at(mbps: int, snr_db: float, seed: int,
+            batched_tx: bool = True) -> float:
+    psdus = _psdus(seed)
+    got = link.loopback_ber_bits(psdus, mbps, snr_db, seed,
+                                 batched_tx=batched_tx)
+    return _ber_from_bits(got, psdus)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mbps,snr", [(24, 8.0), (6, 2.0)])
+def test_perframe_oracle_lane_equals_batched(mbps, snr):
+    """The pre-batched per-frame TX path (one encode_frame per frame)
+    is the oracle the batched lane is judged against: same seeds, same
+    AWGN keys, EQUAL BER — the frames are bit-identical, so the noisy
+    captures and the decode are too."""
+    psdus = _psdus(7)
+    got_b = link.loopback_ber_bits(psdus, mbps, snr, 7, batched_tx=True)
+    got_f = link.loopback_ber_bits(psdus, mbps, snr, 7, batched_tx=False)
+    np.testing.assert_array_equal(got_b, got_f)
 
 
 def _q(x):

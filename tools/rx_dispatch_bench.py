@@ -29,6 +29,14 @@ smoke or a manual chip window:
   measured by the instrumented utils/dispatch counter and
   identity-gated lane for lane.
 
+- ``link_loopback_stats`` (ISSUE 3 tentpole): the full device-resident
+  TX -> channel -> RX loopback (phy/link.loopback_many) over an
+  all-8-rates mixed-length batch — <= 5 dispatches and frames/s for
+  the batched link vs >= 5N for the per-frame encode/impair/receive
+  loop, identity-gated lane for lane; dispatch counts from the
+  instrumented counter, so the artifact records the measured
+  O(N) -> O(1) collapse of the transmit side too.
+
 Standalone: ``ZIRIA_TOOL_ALLOW_CPU=1 python tools/rx_dispatch_bench.py``
 runs all at shrunk sizes on CPU (results labelled platform=cpu,
 never mistakable for chip evidence). Emits ONE JSON object.
@@ -247,6 +255,55 @@ def batched_acquire_stats(n_bytes=100, viterbi_metric=None):
     }
 
 
+def link_loopback_stats(n_frames=8, n_bytes=100, snr_db=28.0):
+    """The closed TX -> channel -> RX loop, batched vs per-frame:
+    dispatch counts (instrumented counter), wall times, frames/s, and
+    a lane-for-lane identity gate. All 8 rates with mixed lengths ride
+    one batch; the channel applies per-lane CFO + delay + AWGN with
+    counter-derived keys, identical in both paths. Returns a flat
+    dict."""
+    from ziria_tpu.phy import link
+    from ziria_tpu.phy.wifi.params import RATES
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    rng = np.random.default_rng(14)
+    mbps = sorted(RATES) * (-(-n_frames // len(RATES)))
+    mbps = mbps[:n_frames]
+    lens = [max(5, n_bytes - 7 * (k % 5)) for k in range(n_frames)]
+    psdus = [rng.integers(0, 256, n).astype(np.uint8) for n in lens]
+    cfo = [(-1) ** k * 1e-4 * (k % 7 + 1) for k in range(n_frames)]
+    delay = [20 + 13 * k for k in range(n_frames)]
+    kw = dict(snr_db=snr_db, cfo=cfo, delay=delay, seed=6)
+
+    with count_dispatches() as d_pf:
+        res_f = link.loopback_many(psdus, mbps, batched_tx=False, **kw)
+    t_pf = _timed(lambda: link.loopback_many(
+        psdus, mbps, batched_tx=False, **kw))
+
+    with count_dispatches() as d_bat:
+        res_b = link.loopback_many(psdus, mbps, batched_tx=True, **kw)
+    t_bat = _timed(lambda: link.loopback_many(
+        psdus, mbps, batched_tx=True, **kw))
+
+    assert all(a.ok and b.ok for a, b in zip(res_f, res_b))
+    assert all(np.array_equal(a.psdu_bits, b.psdu_bits)
+               for a, b in zip(res_f, res_b)), \
+        "batched loopback diverged from the per-frame path"
+
+    return {
+        "frames": n_frames, "max_frame_bytes": max(lens),
+        "rates": sorted(set(mbps)), "snr_db": snr_db,
+        "dispatches_perframe": d_pf.total,
+        "dispatches_batched": d_bat.total,
+        "dispatch_breakdown_batched": dict(d_bat.counts),
+        "t_perframe_s": round(t_pf, 4),
+        "t_batched_s": round(t_bat, 4),
+        "fps_perframe": round(n_frames / t_pf, 1),
+        "fps_batched": round(n_frames / t_bat, 1),
+        "bit_identical": True,
+    }
+
+
 def main():
     import jax
 
@@ -264,12 +321,14 @@ def main():
         out["quantized"] = quantized_sweep(B=8, n_bytes=100, k1=2, k2=4)
         out["mixed_dispatch"] = mixed_dispatch_stats(n_bytes=60)
         out["batched_acquire"] = batched_acquire_stats(n_bytes=60)
+        out["link_loopback"] = link_loopback_stats(n_bytes=24)
     else:
         out["quantized"] = quantized_sweep()
         out["mixed_dispatch"] = mixed_dispatch_stats()
         out["mixed_dispatch_i16"] = mixed_dispatch_stats(
             viterbi_metric="int16")
         out["batched_acquire"] = batched_acquire_stats()
+        out["link_loopback"] = link_loopback_stats()
     print(json.dumps(out))
     return 0
 

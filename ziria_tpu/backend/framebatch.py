@@ -43,6 +43,7 @@ import numpy as np
 
 from ziria_tpu.backend import chunked as C
 from ziria_tpu.core import ir
+from ziria_tpu.utils.dispatch import pad_lanes, pow2_ceil
 
 
 def _shape_sig(args):
@@ -51,13 +52,6 @@ def _shape_sig(args):
         (tuple(np.shape(x)), np.asarray(x).dtype.str) if not hasattr(
             x, "aval") else (tuple(x.shape), x.dtype.str)
         for x in jax.tree_util.tree_leaves(args))
-
-
-def _pow2(n: int) -> int:
-    b = 1
-    while b < n:
-        b *= 2
-    return b
 
 
 class _Req:
@@ -149,7 +143,7 @@ class StepBatcher:
                     r.result = r.node._fns[r.key](*r.args)
                 else:
                     lanes = len(reqs)
-                    padded = reqs + [reqs[0]] * (_pow2(lanes) - lanes)
+                    padded = pad_lanes(reqs)
                     stacked = jax.tree_util.tree_map(
                         lambda *xs: jnp.stack(xs),
                         *[r.args for r in padded])
@@ -232,10 +226,7 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
 
     import jax.numpy as jnp
 
-    from ziria_tpu.ops.crc import check_crc32
     from ziria_tpu.phy.wifi import rx as _rx
-    from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
-    from ziria_tpu.utils import dispatch
 
     if batched_acquire is None:
         batched_acquire = os.environ.get(
@@ -259,14 +250,35 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
     # smaller frames pay pad symbols (zero-LLR erasures), not a second
     # compile or a second dispatch
     n_sym_b = max(_rx._sym_bucket(a.n_sym) for _i, a in acqs)
-    lanes = len(acqs)
-    padded = acqs + [acqs[0]] * (_pow2(lanes) - lanes)
+    padded = pad_lanes(acqs)
     if batched_acquire:
         segs = _rx.gather_segments_many(
             x_dev, [a for _i, a in padded], n_sym_b)
     else:
         segs = jnp.stack([_rx._padded_segment(a, n_sym_b)
                           for _i, a in padded])
+    return _mixed_decode_tail(acqs, padded, segs, n_sym_b, results,
+                              check_fcs, viterbi_window, viterbi_metric)
+
+
+def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
+                       results: List[Any], check_fcs: bool,
+                       viterbi_window, viterbi_metric):
+    """The shared tail of every batched receive surface: ONE
+    mixed-rate decode dispatch over the lane-padded segments, then the
+    per-lane PSDU slice/CRC. `acqs` is [(i, acq)] for the real lanes
+    (acq needs .rate_mbps/.n_sym/.length_bytes — both the host
+    `_Acquired` and batched `_LaneAcq` shapes qualify); `padded` is
+    THE pad_lanes list the caller built `segs` from — passed in, not
+    recomputed, so the ridx/nbits rows can never disagree with the
+    segment rows."""
+    import jax.numpy as jnp
+
+    from ziria_tpu.ops.crc import check_crc32
+    from ziria_tpu.phy.wifi import rx as _rx
+    from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
+    from ziria_tpu.utils import dispatch
+
     ridx = jnp.asarray([_rx.RATE_INDEX[a.rate_mbps] for _i, a in padded],
                        jnp.int32)
     nbits = jnp.asarray(
@@ -283,6 +295,62 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
         results[i] = _rx.RxResult(True, a.rate_mbps, a.length_bytes,
                                   psdu, crc)
     return results
+
+
+def receive_many_device(x_dev, n_lanes: int, check_fcs: bool = False,
+                        viterbi_window: int = None,
+                        viterbi_metric: str = None) -> List[Any]:
+    """Batched receive over an ALREADY device-resident capture batch —
+    the RX side of the loopback link (phy/link.py): the channel's
+    output feeds acquisition without the samples ever crossing the
+    host link.
+
+    x_dev: (R, L, 2) device array, R a power-of-two lane count (rows
+    past `n_lanes` repeating row 0 — the pad_lanes rule) and L a
+    power-of-two >= 512 capture bucket; the WHOLE buffer of every lane
+    is its capture (n_valid = L: the batched channel fills it with
+    real air samples). Three dispatches — acquire -> gather -> mixed
+    decode — with results bit-identical to per-capture `rx.receive`
+    over `np.asarray(x_dev[i])`."""
+    from ziria_tpu.phy.wifi import rx as _rx
+
+    l_cap = int(x_dev.shape[1])
+    if l_cap != _rx._stream_bucket(l_cap):
+        raise ValueError(
+            f"capture length {l_cap} is not a power-of-two >= 512 "
+            f"bucket; per-capture receive would pad to "
+            f"{_rx._stream_bucket(l_cap)} and the identity contract "
+            f"needs identical geometry")
+    nv = np.full((int(x_dev.shape[0]),), l_cap, np.int32)
+    results, lanes = _rx.acquire_batch(x_dev, nv, nv, n_lanes)
+    if not lanes:
+        return results
+    n_sym_b = max(_rx._sym_bucket(a.n_sym) for _i, a in lanes)
+    padded = pad_lanes(lanes)
+    segs = _rx.gather_segments_many(
+        x_dev, [a for _i, a in padded], n_sym_b)
+    return _mixed_decode_tail(lanes, padded, segs, n_sym_b, results,
+                              check_fcs, viterbi_window, viterbi_metric)
+
+
+def transmit_many(psdus, rates_mbps, add_fcs: bool = False,
+                  batched_tx: Optional[bool] = None) -> List[np.ndarray]:
+    """One-dispatch mixed-rate TX batch surface (thin re-export of
+    phy/link.transmit_many, next to its RX twin `receive_many`): N
+    frames encoded as ONE vmap(lax.switch) device call, returned at
+    their true lengths — or the per-frame oracle loop under
+    ``ZIRIA_BATCHED_TX=0`` — bit-identical either way."""
+    from ziria_tpu.phy import link
+    return link.transmit_many(psdus, rates_mbps, add_fcs=add_fcs,
+                              batched_tx=batched_tx)
+
+
+def loopback_many(psdus, rates_mbps, **kw) -> List[Any]:
+    """The full device-resident N-frame loopback (thin re-export of
+    phy/link.loopback_many): encode -> per-lane channel -> batched
+    receive in ~5 dispatches total."""
+    from ziria_tpu.phy import link
+    return link.loopback_many(psdus, rates_mbps, **kw)
 
 
 def run_many(comp: ir.Comp, frames: Sequence[Sequence[Any]],

@@ -9,6 +9,8 @@ offset, and multipath FIR — everything jax, batchable over frames.
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -48,6 +50,119 @@ def delay(key, samples, n_before: int, n_after: int = 0,
     amp = jnp.sqrt(p_sig * 10.0 ** (noise_db / 10.0) / 2.0)
     pad = jax.random.normal(key, (n_before + n_after, 2)) * amp
     return jnp.concatenate([pad[:n_before], x, pad[n_before:]], axis=0)
+
+
+# ------------------------------------------------- batched link channel
+#
+# The device-resident loopback link (phy/link.py) needs the channel as
+# ONE vmapped dispatch over a frame batch with PER-LANE parameters —
+# the composable helpers above are host-loop shaped (python-scalar
+# params, shape-changing delay). `impair_graph` is the same physics at
+# a fixed geometry: CFO rotation, integer delay as a roll into the
+# zero tail, and AWGN at the lane's own SNR, every parameter a traced
+# per-lane scalar. Keys derive from one seed by lane-counter fold-in,
+# so lane i's noise never depends on the batch composition.
+
+
+def impair_graph(x, n_valid, snr_db, eps, delay, key) -> jnp.ndarray:
+    """One lane of the batched link channel, all shapes static.
+
+    x: (L, 2) TX samples, only the first `n_valid` (traced int32) of
+    which are the frame — anything past is masked to zero HERE (an
+    encode_many lane's bucket pad carries garbage symbols, which must
+    neither transmit nor count as signal power); snr_db/eps/delay
+    (traced scalars): the lane's own AWGN SNR (``inf`` disables noise
+    exactly — the noise term multiplies to 0), CFO in rad/sample, and
+    integer sample delay (must satisfy delay + n_valid <= L, or the
+    frame tail wraps around). Returns (L, 2). Under ``vmap`` this is
+    the whole channel of an N-frame batch in one dispatch;
+    single-lane calls are the per-frame oracle the batched path is
+    judged against — the mask makes the two agree bit-for-bit
+    whatever the caller's pad region holds (the select passes real
+    samples through untouched)."""
+    x = jnp.asarray(x, jnp.float32)
+    idx = jnp.arange(x.shape[0])
+    x = jnp.where((idx < n_valid)[:, None], x, 0.0)
+    n = idx.astype(jnp.float32)
+    x = cplx.cmul(x, cplx.cexp(jnp.float32(eps) * n))   # zeros stay 0
+    x = jnp.roll(x, delay, axis=0)     # circular, but the zero tail
+    #                                    makes it a pure shift
+    p_sig = jnp.sum(cplx.cabs2(x)) / jnp.maximum(
+        jnp.asarray(n_valid, jnp.float32), 1.0)
+    p_noise = p_sig / (10.0 ** (jnp.asarray(snr_db, jnp.float32) / 10.0))
+    noise = jax.random.normal(key, x.shape) * jnp.sqrt(p_noise / 2.0)
+    return x + noise
+
+
+def lane_key(seed, i):
+    """Counter-derived per-lane PRNG key: fold the lane index into the
+    batch seed. The same key reaches lane i whether the channel runs
+    batched or per-frame — the bit-identity hinge of the link tests."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), i)
+
+
+@lru_cache(maxsize=None)
+def _jit_impair_many(out_len: int):
+    """ONE jitted vmapped channel per output length (jit retraces per
+    input shape): pads the TX batch to `out_len`, derives per-lane
+    keys by counter fold-in, and applies every lane's own impairments
+    in one dispatch."""
+    def f(x_b, n_valid, snr_db, eps, delay, seed):
+        pad = out_len - x_b.shape[1]
+        x = jnp.pad(jnp.asarray(x_b, jnp.float32),
+                    ((0, 0), (0, pad), (0, 0)))
+        keys = jax.vmap(lambda i: lane_key(seed, i))(
+            jnp.arange(x.shape[0]))
+        return jax.vmap(impair_graph)(x, n_valid, snr_db, eps, delay,
+                                      keys)
+    return jax.jit(f)
+
+
+def impair_many(x_b, n_valid, snr_db, eps, delay, seed,
+                out_len: int = None) -> jnp.ndarray:
+    """Batched per-lane channel: (R, L, 2) device-resident TX batch ->
+    (R, out_len, 2) impaired captures in ONE dispatch, staying on
+    device for the receiver. Per-lane arrays for n_valid/snr_db/eps/
+    delay (scalars broadcast); `seed` one int — lane keys derive by
+    counter fold-in (`lane_key`). Bit-identical per lane to a
+    single-lane `impair_graph` call with the same key."""
+    from ziria_tpu.utils import dispatch
+
+    r = int(x_b.shape[0])
+    if out_len is None:
+        out_len = int(x_b.shape[1])
+
+    def _vec(v, dtype):
+        a = np.broadcast_to(np.asarray(v, dtype), (r,))
+        return jnp.asarray(a)
+
+    dispatch.record("channel.impair_many")
+    return _jit_impair_many(int(out_len))(
+        x_b, _vec(n_valid, np.int32), _vec(snr_db, np.float32),
+        _vec(eps, np.float32), _vec(delay, np.int32),
+        jnp.uint32(seed))
+
+
+@lru_cache(maxsize=None)
+def _jit_impair_one():
+    return jax.jit(impair_graph)
+
+
+def impair_one(samples, snr_db, eps, delay, seed, lane: int,
+               out_len: int) -> jnp.ndarray:
+    """The per-frame oracle of `impair_many`: one lane's impairments
+    through the SAME graph with the SAME counter-derived key
+    (`lane_key(seed, lane)`), the frame zero-padded to `out_len`
+    host-side. Bit-identical to row `lane` of the batched dispatch."""
+    from ziria_tpu.utils import dispatch
+
+    x = np.zeros((int(out_len), 2), np.float32)
+    s = np.asarray(samples, np.float32)
+    x[:s.shape[0]] = s
+    dispatch.record("channel.impair")
+    return _jit_impair_one()(
+        jnp.asarray(x), jnp.int32(s.shape[0]), jnp.float32(snr_db),
+        jnp.float32(eps), jnp.int32(delay), lane_key(seed, lane))
 
 
 def multipath(samples, taps_pair) -> jnp.ndarray:

@@ -1,0 +1,169 @@
+"""Device-resident TX → channel → RX loopback link.
+
+The closed loop the reference ran over SORA/BladeRF hardware (Sora's
+NSDI 2009 real-time link; the Ziria transceiver demo drives it
+in-language) — here the "air" is the batched synthetic channel and the
+whole N-frame round trip compiles to a handful of device programs:
+
+    tx.encode_many          ONE vmap(lax.switch) mixed-rate encode
+    channel.impair_many     ONE vmapped per-lane AWGN/CFO/delay
+    rx.acquire_batch        ONE vmapped detect/align/CFO/SIGNAL
+    rx.gather_segments_many ONE gather+derotate at the common bucket
+    rx.decode_data_mixed    ONE mixed-rate DATA decode
+
+— ~5 device dispatches for any N-frame, all-rates, multi-SNR batch,
+with the sample arrays staying device-resident between stages (the
+TX batch never crosses the host link until the decoded bits come
+back). That makes BER-waterfall-style sweeps — this repo's serving
+workload — O(1)-dispatch in the batch size.
+
+``batched_tx=False`` (or ``--no-batched-tx`` / ``ZIRIA_BATCHED_TX=0``
+through the CLI's scoped-env pattern) runs the per-frame oracle loop
+instead: encode_frame + single-lane channel + rx.receive per frame,
+>= 5 dispatches per lane — bit-identical lane for lane to the batched
+path (tests/test_tx_batched.py pins it; tools/rx_dispatch_bench.py
+``link_loopback_stats`` measures it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ziria_tpu.backend import framebatch
+from ziria_tpu.phy import channel
+from ziria_tpu.phy.wifi import rx, tx
+from ziria_tpu.phy.wifi.params import RATES, n_symbols
+
+
+def batched_tx_enabled(batched_tx: Optional[bool] = None) -> bool:
+    """The ONE reading of the --batched-tx / ZIRIA_BATCHED_TX knob
+    (default ON), shared by every TX-batch surface."""
+    if batched_tx is not None:
+        return batched_tx
+    return os.environ.get("ZIRIA_BATCHED_TX", "1") != "0"
+
+
+def transmit_many(psdus: Sequence, rates_mbps: Sequence[int],
+                  add_fcs: bool = False,
+                  batched_tx: Optional[bool] = None) -> List[np.ndarray]:
+    """N mixed-rate, mixed-length frames -> per-frame sample arrays at
+    their true lengths: ONE encode_many dispatch plus one batched
+    copy-out (default), or the per-frame encode_frame oracle loop
+    (``ZIRIA_BATCHED_TX=0``). Bit-identical either way — including
+    the empty batch, which is [] in both modes (receive_many's
+    convention), never a mode-dependent raise."""
+    if not len(psdus):
+        return []
+    if not batched_tx_enabled(batched_tx):
+        return [np.asarray(tx.encode_frame(p, m, add_fcs=add_fcs))
+                for p, m in zip(psdus, rates_mbps)]
+    txb = tx.encode_many(psdus, rates_mbps, add_fcs=add_fcs)
+    arr = np.asarray(txb.samples[:len(psdus)])   # pad rows never move
+    return [arr[i, :int(v)] for i, v in enumerate(txb.n_valid)]
+
+
+def _lane_param(v, n: int, dtype) -> np.ndarray:
+    return np.broadcast_to(np.asarray(v, dtype), (n,)).copy()
+
+
+def loopback_many(psdus: Sequence, rates_mbps: Sequence[int],
+                  snr_db=np.inf, cfo=0.0, delay=0, seed: int = 0,
+                  add_fcs: bool = False, check_fcs: bool = False,
+                  batched_tx: Optional[bool] = None,
+                  viterbi_window: int = None,
+                  viterbi_metric: str = None) -> List:
+    """The full N-frame mixed-rate loopback: encode → per-lane channel
+    impairments → batched acquire → gather → mixed-rate decode, in ~5
+    device dispatches total, arrays device-resident between stages.
+
+    ``snr_db``/``cfo``/``delay`` are scalars or per-lane sequences
+    (``np.inf`` SNR disables noise exactly); lane noise keys derive
+    from ``seed`` by counter fold-in, so lane i sees the same channel
+    whether it runs batched or alone. Returns per-frame
+    :class:`rx.RxResult`, lane-for-lane bit-identical to the per-frame
+    oracle loop (``batched_tx=False``: encode_frame + single-lane
+    `channel.impair_graph` + `rx.receive` per frame)."""
+    n = len(psdus)
+    if len(rates_mbps) != n:
+        raise ValueError(f"{n} PSDUs but {len(rates_mbps)} rates")
+    if n == 0:
+        return []          # match receive_many's empty-batch behavior
+    snr = _lane_param(snr_db, n, np.float32)
+    eps = _lane_param(cfo, n, np.float32)
+    dly = _lane_param(delay, n, np.int32)
+    if (dly < 0).any():
+        raise ValueError("negative delay")
+    # ONE capture length for the whole link, batched or not: the
+    # common symbol bucket's frame length plus the worst delay, at the
+    # receiver's capture-bucket rule. The per-frame oracle MUST use
+    # the same length — a lane's noise field is drawn over the whole
+    # buffer, so per-lane buffer sizes would change the draws and the
+    # bit-identity contract with the batched path.
+    fcs_bytes = 4 if add_fcs else 0
+    sym_b = max(tx._sym_bucket(n_symbols(
+        int(np.asarray(p).size) + fcs_bytes, RATES[m]))
+        for p, m in zip(psdus, rates_mbps))
+    l_cap = rx._stream_bucket(400 + 80 * sym_b + int(dly.max()))
+
+    if not batched_tx_enabled(batched_tx):
+        # the per-frame oracle: same channel physics, one frame at a
+        # time, through the per-capture receiver
+        results = []
+        for i in range(n):
+            s = np.asarray(tx.encode_frame(psdus[i], rates_mbps[i],
+                                           add_fcs=add_fcs))
+            cap = channel.impair_one(s, snr[i], eps[i], int(dly[i]),
+                                     seed, i, l_cap)
+            results.append(rx.receive(np.asarray(cap),
+                                      check_fcs=check_fcs,
+                                      viterbi_window=viterbi_window,
+                                      viterbi_metric=viterbi_metric))
+        return results
+
+    txb = tx.encode_many(psdus, rates_mbps, add_fcs=add_fcs)
+    rows = int(txb.samples.shape[0])
+    assert int(txb.samples.shape[1]) == 400 + 80 * sym_b
+    nv_tx = np.full((rows,), txb.n_valid[0], np.int32)
+    nv_tx[:n] = txb.n_valid
+
+    def _pad_rows(a):
+        out = np.concatenate([a, np.broadcast_to(a[0], (rows - n,)
+                                                 + a.shape[1:])])
+        return out
+
+    caps = channel.impair_many(
+        txb.samples, nv_tx, _pad_rows(snr), _pad_rows(eps),
+        _pad_rows(dly), seed, out_len=l_cap)
+    return framebatch.receive_many_device(
+        caps, n, check_fcs=check_fcs, viterbi_window=viterbi_window,
+        viterbi_metric=viterbi_metric)
+
+
+def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
+                      batched_tx: Optional[bool] = None) -> np.ndarray:
+    """Perfect-sync single-rate BER loopback — the statistical lane of
+    the link (BER waterfalls measure the equalize/demap/Viterbi chain,
+    not packet detection): (B, n_bytes) PSDUs encode in ONE dispatch
+    (`tx.encode_batch`; per-frame `encode_frame` loop when batched TX
+    is off — bit-identical), AWGN rides one vmapped dispatch with
+    per-lane split keys, and the batched DATA decode returns the
+    decoded PSDU bits (B, 8*n_bytes)."""
+    psdus = np.asarray(psdus, np.uint8)
+    rate = RATES[rate_mbps]
+    n_bytes = psdus.shape[1]
+    n_sym = n_symbols(n_bytes, rate)
+    if batched_tx_enabled(batched_tx):
+        frames = tx.encode_batch(psdus, rate_mbps)
+    else:
+        frames = jnp.stack([jnp.asarray(tx.encode_frame(p, rate_mbps))
+                            for p in psdus])
+    keys = jax.random.split(jax.random.PRNGKey(seed), psdus.shape[0])
+    noisy = jax.vmap(
+        lambda k, f: channel.awgn(k, f, snr_db))(keys, frames)
+    got, _ = rx.decode_data_batch(noisy, rate, n_sym, 8 * n_bytes)
+    return np.asarray(got)

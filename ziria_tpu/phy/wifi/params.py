@@ -34,6 +34,14 @@ RATES: Dict[int, RateParams] = {
 
 SIGNAL_BITS_TO_MBPS = {p.signal_bits: m for m, p in RATES.items()}
 
+# the ONE rate ordering every mixed-rate ``lax.switch`` uses (TX
+# encode_many and RX decode_data_mixed build their branch lists from
+# it; a disagreement would decode a lane at the wrong rate) — pinned
+# by tests/test_rx_mixed_dispatch.py::test_rate_index_order...
+RATE_MBPS_ORDER = tuple(sorted(RATES))
+RATE_INDEX = {m: i for i, m in enumerate(RATE_MBPS_ORDER)}
+MAX_DBPS = max(p.n_dbps for p in RATES.values())     # 216 (54 Mbps)
+
 N_SERVICE_BITS = 16
 N_TAIL_BITS = 6
 

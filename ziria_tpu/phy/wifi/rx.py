@@ -29,9 +29,15 @@ import numpy as np
 from ziria_tpu.ops import cplx, coding, demap as demap_mod, interleave, ofdm, \
     scramble, sync, viterbi, viterbi_pallas
 from ziria_tpu.ops.crc import check_crc32
-from ziria_tpu.phy.wifi.params import (N_SERVICE_BITS, N_TAIL_BITS,
-                                       RateParams, RATES,
-                                       SIGNAL_BITS_TO_MBPS, n_symbols)
+# MAX_DBPS / RATE_INDEX / RATE_MBPS_ORDER: the lax.switch branch
+# order shared with TX encode_many (hoisted to params so both sides
+# of the link agree by construction), re-exported here because this
+# module is where the switch-order contract is consumed
+from ziria_tpu.phy.wifi.params import (MAX_DBPS, N_SERVICE_BITS,
+                                       N_TAIL_BITS, RATE_INDEX,
+                                       RATE_MBPS_ORDER, RateParams,
+                                       RATES, SIGNAL_BITS_TO_MBPS,
+                                       n_symbols)
 from ziria_tpu.utils.bits import bits_to_uint
 
 FRAME_DATA_START = 400  # 320 preamble + 80 SIGNAL
@@ -223,15 +229,14 @@ def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
 
 def _sym_bucket(n_sym: int) -> int:
     """Power-of-two symbol bucket (min 4 keeps tiny frames in one
-    compile class)."""
-    return 1 << max(2, (n_sym - 1).bit_length())
+    compile class). Shared with the TX batch path (tx.encode_many
+    buckets its symbol counts with the same rule, so a loopback's
+    encode and decode geometries agree)."""
+    from ziria_tpu.utils.dispatch import pow2_bucket
+    return pow2_bucket(n_sym, 4)
 
 
 # ------------------------------------------------------- mixed-rate dispatch
-
-MAX_DBPS = max(p.n_dbps for p in RATES.values())     # 216 (54 Mbps)
-RATE_MBPS_ORDER = tuple(sorted(RATES))               # lax.switch branch order
-RATE_INDEX = {m: i for i, m in enumerate(RATE_MBPS_ORDER)}
 
 
 def decode_data_mixed(frames, rate_idx, n_bits_real, n_sym_bucket: int,
@@ -351,7 +356,8 @@ def _stream_bucket(n: int) -> int:
     """Power-of-two capture bucket (min 512): the ONE padding formula
     the per-capture and batched acquisition paths share — their
     bit-identity contract assumes identical padded geometry rules."""
-    return 1 << max(9, (n - 1).bit_length())
+    from ziria_tpu.utils.dispatch import pow2_bucket
+    return pow2_bucket(n, 512)
 
 
 def _bucket_pad(x: np.ndarray):
@@ -470,56 +476,31 @@ class _LaneAcq(NamedTuple):
     n_sym: int
 
 
-def acquire_many(captures, max_samples: int = 1 << 16):
-    """Batched acquisition front end: N captures -> per-lane
-    (found, start, eps, rate_bits, length, parity_ok) in ONE device
-    dispatch, then the host decision tree (integer parsing only).
+def acquire_batch(x_dev, n_valid, limits, n_lanes: int):
+    """Batched acquisition over an ALREADY device-resident capture
+    batch: ONE vmapped dispatch + the host integer decision tree.
 
-    Returns (results, x_dev, lanes): `results[i]` is the failure
-    RxResult for undecodable lanes and None for decodable ones,
-    `x_dev` is the (N_pow2, L, 2) bucket-padded capture batch as the
-    DEVICE array the acquire dispatch already uploaded (kept resident
-    so the gather dispatch slices data regions without a second trip
-    through the host link), `lanes` is [(i, _LaneAcq)] for the
-    decodable lanes. Lane-for-lane, the classification and every
-    parsed field are bit-identical to per-capture `_acquire_frame`."""
+    x_dev: (R, L, 2) device array, R a power-of-two lane count and L
+    a power-of-two capture bucket, rows past the real lanes repeating
+    row 0 (the `utils/dispatch.pad_lanes` rule); n_valid/limits: (R,)
+    int arrays (true capture lengths and per-lane own-bucket caps for
+    the detector). The first `n_lanes` rows are real. Returns
+    (results, lanes) as `acquire_many` does. This is the entry the
+    device-resident loopback link uses — the TX/channel output feeds
+    acquisition without ever crossing the host link."""
     from ziria_tpu.utils import dispatch
 
-    if not len(captures):
-        return [], jnp.zeros((0, 0, 2), jnp.float32), []
-    xs = [np.asarray(s, np.float32)[:max_samples] for s in captures]
-    n_valid = np.asarray([x.shape[0] for x in xs], np.int32)
-    # ONE common bucket for the whole batch (zeros are inert to the
-    # detector and to the conv outputs at real-sample positions, so a
-    # longer pad does not change any lane's values), and lane counts
-    # pad to a power of two (lane 0 repeated) so XLA compiles O(log N)
-    # batch variants
-    bucket = _stream_bucket(int(n_valid.max()))
-    n_lanes = len(xs)
-    n_rows = 1 << max(0, (n_lanes - 1).bit_length())
-    x_pad = np.zeros((n_rows, bucket, 2), np.float32)
-    for i, x in enumerate(xs):
-        x_pad[i, :x.shape[0]] = x
-    if n_lanes < n_rows:
-        x_pad[n_lanes:] = x_pad[0]
-    nv_pad = np.full((n_rows,), n_valid[0], np.int32)
-    nv_pad[:n_lanes] = n_valid
-    # each lane's OWN bucket caps its detect/peak-pick positions so
-    # sharing a longer common bucket cannot expose tail windows the
-    # per-capture path never evaluates (sync.locate_frame's limit)
-    limits = np.asarray([_stream_bucket(int(v)) for v in nv_pad],
-                        np.int32)
-
     dispatch.record("rx.acquire_many")
-    x_dev = jnp.asarray(x_pad)
     found_b, start_b, eps_b, rb_b, ln_b, pk_b = _jit_acquire_many()(
-        x_dev, jnp.asarray(nv_pad), jnp.asarray(limits))
+        x_dev, jnp.asarray(n_valid, jnp.int32),
+        jnp.asarray(limits, jnp.int32))
     found_b = np.asarray(found_b)
     start_b = np.asarray(start_b)
     eps_b = np.asarray(eps_b)
     rb_b = np.asarray(rb_b)
     ln_b = np.asarray(ln_b)
     pk_b = np.asarray(pk_b)
+    n_valid = np.asarray(n_valid)
 
     results = [None] * n_lanes
     lanes = []
@@ -535,6 +516,51 @@ def acquire_many(captures, max_samples: int = 1 << 16):
         rate_mbps, n_sym = ok
         lanes.append((i, _LaneAcq(i, start, float(eps_b[i]), avail,
                                   rate_mbps, int(ln_b[i]), n_sym)))
+    return results, lanes
+
+
+def acquire_many(captures, max_samples: int = 1 << 16):
+    """Batched acquisition front end: N captures -> per-lane
+    (found, start, eps, rate_bits, length, parity_ok) in ONE device
+    dispatch, then the host decision tree (integer parsing only).
+
+    Returns (results, x_dev, lanes): `results[i]` is the failure
+    RxResult for undecodable lanes and None for decodable ones,
+    `x_dev` is the (N_pow2, L, 2) bucket-padded capture batch as the
+    DEVICE array the acquire dispatch already uploaded (kept resident
+    so the gather dispatch slices data regions without a second trip
+    through the host link), `lanes` is [(i, _LaneAcq)] for the
+    decodable lanes. Lane-for-lane, the classification and every
+    parsed field are bit-identical to per-capture `_acquire_frame`."""
+    from ziria_tpu.utils.dispatch import pow2_ceil
+
+    if not len(captures):
+        return [], jnp.zeros((0, 0, 2), jnp.float32), []
+    xs = [np.asarray(s, np.float32)[:max_samples] for s in captures]
+    n_valid = np.asarray([x.shape[0] for x in xs], np.int32)
+    # ONE common bucket for the whole batch (zeros are inert to the
+    # detector and to the conv outputs at real-sample positions, so a
+    # longer pad does not change any lane's values), and lane counts
+    # pad to a power of two (lane 0 repeated) so XLA compiles O(log N)
+    # batch variants
+    bucket = _stream_bucket(int(n_valid.max()))
+    n_lanes = len(xs)
+    n_rows = pow2_ceil(n_lanes)
+    x_pad = np.zeros((n_rows, bucket, 2), np.float32)
+    for i, x in enumerate(xs):
+        x_pad[i, :x.shape[0]] = x
+    if n_lanes < n_rows:
+        x_pad[n_lanes:] = x_pad[0]
+    nv_pad = np.full((n_rows,), n_valid[0], np.int32)
+    nv_pad[:n_lanes] = n_valid
+    # each lane's OWN bucket caps its detect/peak-pick positions so
+    # sharing a longer common bucket cannot expose tail windows the
+    # per-capture path never evaluates (sync.locate_frame's limit)
+    limits = np.asarray([_stream_bucket(int(v)) for v in nv_pad],
+                        np.int32)
+
+    x_dev = jnp.asarray(x_pad)
+    results, lanes = acquire_batch(x_dev, nv_pad, limits, n_lanes)
     return results, x_dev, lanes
 
 

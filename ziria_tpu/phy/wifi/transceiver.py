@@ -6,7 +6,11 @@ minimal MAC). No radio hardware in this build, so the "air" is an
 explicit channel function (phy/channel.py) and time is sample counts at
 20 Msps; everything else mirrors the reference's split:
 
-- PHY: `tx.encode_frame` / `rx.receive` (jitted per (rate, n_sym));
+- PHY: `tx.encode_frame` / `rx.receive` — the encode dispatches
+  through tx's lru-cached jit per (rate, bit bucket, symbol bucket),
+  so repeated sends (DATA frames AND the per-receive ACKs) reuse
+  compiled encoders instead of re-tracing; pinned by
+  test_transceiver.py::test_emit_reuses_compiled_encoder;
 - MAC-lite: a 4-byte header [type, seq, dst, src] + CRC32 FCS inside
   the PSDU; DATA frames are ACKed after SIFS; the sender retransmits on
   ACK timeout up to a retry limit (stop-and-wait ARQ — the shape of the
@@ -177,6 +181,9 @@ class Station:
         return None
 
     def _emit(self, psdu: np.ndarray, rate: int) -> np.ndarray:
+        # encode_frame routes through tx._jit_encode_frame (cached per
+        # (rate, bit bucket, symbol bucket)): every send after the
+        # first at a given geometry is a pure dispatch, no re-trace
         samples = np.asarray(tx.encode_frame(psdu, rate), np.float32)
         self.now += samples.shape[0]
         return samples

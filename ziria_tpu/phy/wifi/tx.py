@@ -4,13 +4,21 @@ Counterpart of the reference's `code/WiFi/transmitter/` top-level
 `tx.blk` (SURVEY.md §2.3, §3.5): crc >>> scramble >>> convEncode+puncture
 >>> interleave >>> modulate >>> map_ofdm >>> ifft >>> preamble/CP.
 
-Two forms, per the framework's TPU-first design:
+Three forms, per the framework's TPU-first design:
 
-- ``encode_frame`` — a *frame-level* pure jax function: the whole PSDU
-  to time-domain samples in one traced graph. This is the batched path:
-  ``jax.vmap(encode_frame_bits, ...)`` processes a batch of frames as
-  one device program (frame batching = the new data-parallel axis,
-  SURVEY.md §2.4).
+- ``encode_frame`` — the per-frame entry: the whole PSDU to time-domain
+  samples. Routed through an lru-cached jit per (rate, bit bucket,
+  symbol bucket) — repeated sends at varied lengths reuse O(log
+  buckets) compiled encoders instead of re-tracing eagerly per call
+  (``encode_frame_bits`` stays the untraced-oracle graph form for
+  callers composing their own jit/vmap).
+- ``encode_many`` — the one-dispatch batched TX (the transmit twin of
+  rx.decode_data_mixed): an N-frame batch of MIXED rates and lengths
+  encodes as ONE jitted ``vmap(lax.switch)`` over per-rate bucketed
+  encoders at a common (bit-bucket, symbol-bucket) geometry,
+  bit-identical lane for lane to per-frame ``encode_frame``, with
+  per-lane valid sample counts returned. ``encode_batch`` is the
+  single-rate vmapped sibling (one cheap branch, the BER-sweep lane).
 - ``tx_symbol_pipeline`` — the same DATA-symbol steady state expressed
   as a DSL pipeline (map_accum stages carrying scrambler phase, encoder
   tail, and symbol counter), demonstrating that the combinator IR
@@ -18,19 +26,25 @@ Two forms, per the framework's TPU-first design:
   program.
 
 Frame assembly (preamble, SIGNAL symbol, padding) is inherently
-per-frame and lives only in the frame-level form.
+per-frame and lives in the frame-level forms.
 """
 
 from __future__ import annotations
 
+from functools import lru_cache
+from typing import NamedTuple, Sequence
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ziria_tpu.ops import coding, interleave, modulate, ofdm, scramble
 from ziria_tpu.ops.crc import append_crc32
 from ziria_tpu.phy.wifi.params import (N_SERVICE_BITS, N_TAIL_BITS,
+                                       RATE_INDEX, RATE_MBPS_ORDER,
                                        RateParams, RATES, n_symbols)
 from ziria_tpu.utils.bits import bytes_to_bits, uint_to_bits
+from ziria_tpu.utils.dispatch import pad_lanes, pow2_bucket
 
 # the standard's example frame seed; callers may override per frame
 DEFAULT_SCRAMBLER_SEED = 0b1011101
@@ -104,15 +118,234 @@ def encode_frame_bits(psdu_bits, rate: RateParams) -> jnp.ndarray:
     return jnp.concatenate([ofdm.preamble(), sig_t, data_t], axis=0)
 
 
+# --------------------------------------------------------------------------
+# Bucketed / batched encode (the one-dispatch TX)
+# --------------------------------------------------------------------------
+
+
+def _sym_bucket(n_sym: int) -> int:
+    """Power-of-two symbol bucket, floor 4 — the SAME rule as
+    rx._sym_bucket (both sides call utils/dispatch.pow2_bucket), so a
+    loopback's encode and decode geometries agree by construction."""
+    return pow2_bucket(n_sym, 4)
+
+
+def _bit_bucket(n_bits: int) -> int:
+    """Power-of-two PSDU bit bucket (min 128 keeps tiny frames — ACKs,
+    MAC control — in one compile class)."""
+    return pow2_bucket(n_bits, 128)
+
+
+def encode_frame_bits_bucketed(psdu_bits_padded, n_bits_real,
+                               rate: RateParams,
+                               n_sym_bucket: int) -> jnp.ndarray:
+    """PSDU bits at a *bucketed* geometry -> frame time samples padded
+    to ``n_sym_bucket`` DATA symbols: `psdu_bits_padded` is the PSDU
+    zero-padded to a power-of-two bit bucket, `n_bits_real` the true
+    bit count as a TRACED scalar. The first 400 + 80*n_symbols(real)
+    samples are bit-identical to `encode_frame_bits`; the caller
+    slices to the valid length.
+
+    Why the pad is free: the raw DATA field already pads with zeros
+    after the tail, and every stage before the IFFT is position-local
+    — the scrambler XORs a fixed position-indexed sequence, the
+    convolutional encoder is causal, puncture/interleave/modulate are
+    per-position/per-symbol maps — so bucket-pad bits only ever append
+    garbage *symbols* after the real ones, never perturb them. Only
+    the 6 tail-bit positions depend on the true length, re-zeroed by a
+    traced mask exactly as the unbucketed path re-zeroes them.
+    """
+    n_bits = n_sym_bucket * rate.n_dbps
+    bits_pad = jnp.asarray(psdu_bits_padded, jnp.uint8)
+    room = n_bits - N_SERVICE_BITS
+    if bits_pad.shape[0] >= room:
+        body = bits_pad[:room]
+    else:
+        body = jnp.concatenate(
+            [bits_pad, jnp.zeros(room - bits_pad.shape[0], jnp.uint8)])
+    raw = jnp.concatenate([jnp.zeros(N_SERVICE_BITS, jnp.uint8), body])
+    seed = jnp.asarray(_seed_bits_np(DEFAULT_SCRAMBLER_SEED))
+    scrambled = scramble.scramble_bits(raw, seed)
+    # tail bits re-zeroed AFTER scrambling at the TRACED tail position
+    t = jnp.arange(n_bits)
+    tail_at = N_SERVICE_BITS + n_bits_real
+    scrambled = jnp.where((t >= tail_at) & (t < tail_at + N_TAIL_BITS),
+                          0, scrambled)
+    coded = coding.puncture(coding.conv_encode(scrambled), rate.coding)
+    inter = interleave.interleave(coded, rate.n_cbps, rate.n_bpsc)
+    syms = modulate.modulate(inter, rate.n_bpsc).reshape(
+        n_sym_bucket, 48, 2)
+    bins = ofdm.map_subcarriers(syms, symbol_index0=1)
+    data_t = ofdm.ofdm_modulate(bins).reshape(-1, 2)
+    sig_t = encode_signal_symbol(rate, n_bits_real // 8)
+    return jnp.concatenate([ofdm.preamble(), sig_t, data_t], axis=0)
+
+
+@lru_cache(maxsize=None)
+def _jit_encode_frame(rate_mbps: int, bit_bucket: int,
+                      n_sym_bucket: int):
+    """ONE compiled single-frame encoder per (rate, bit bucket, symbol
+    bucket) — what `encode_frame` (and so the transceiver's every
+    send) dispatches through: O(rates x log buckets) compiles total,
+    zero re-tracing across repeated sends."""
+    rate = RATES[rate_mbps]
+
+    def f(bits_pad, n_bits_real):
+        return encode_frame_bits_bucketed(bits_pad, n_bits_real, rate,
+                                          n_sym_bucket)
+
+    return jax.jit(f)
+
+
+@lru_cache(maxsize=None)
+def _jit_encode_batch(rate_mbps: int, bit_bucket: int,
+                      n_sym_bucket: int):
+    """Single-rate vmapped encoder (one cheap branch, no switch): the
+    BER-sweep lane, where every frame in the batch shares one rate."""
+    rate = RATES[rate_mbps]
+
+    def f(bits_b, n_bits_real):
+        return jax.vmap(
+            lambda b: encode_frame_bits_bucketed(
+                b, n_bits_real, rate, n_sym_bucket))(bits_b)
+
+    return jax.jit(f)
+
+
+@lru_cache(maxsize=None)
+def _jit_encode_many(bit_bucket: int, n_sym_bucket: int):
+    """ONE jitted ``vmap(lax.switch)`` over all 8 per-rate bucketed
+    encoders per (bit bucket, symbol bucket) geometry — the TX twin of
+    rx._jit_decode_data_mixed. Under vmap the switch lowers to a
+    select over the branches; each lane's samples come from its own
+    rate's encoder, bit-identical to the single-rate trace."""
+    branches = [
+        (lambda b, n, _r=RATES[m]: encode_frame_bits_bucketed(
+            b, n, _r, n_sym_bucket))
+        for m in RATE_MBPS_ORDER]
+
+    def f(bits_b, nbits_b, ridx_b):
+        return jax.vmap(
+            lambda b, n, r: jax.lax.switch(r, branches, b, n))(
+                bits_b, nbits_b, ridx_b)
+
+    return jax.jit(f)
+
+
+def _host_psdu_bits(psdu_bytes, add_fcs: bool) -> np.ndarray:
+    from ziria_tpu.utils.bits import np_bytes_to_bits
+    bits = np_bytes_to_bits(np.asarray(psdu_bytes, np.uint8))
+    if add_fcs:
+        bits = np.asarray(append_crc32(bits), np.uint8)
+    return bits
+
+
+class TxBatch(NamedTuple):
+    """One-dispatch encoded frame batch, device-resident.
+
+    `samples` rows past the real lanes repeat lane 0 (the pad_lanes
+    rule); `n_valid[i]` is lane i's true sample count — its frame is
+    `samples[i, :n_valid[i]]`, bit-identical to `encode_frame`."""
+    samples: jnp.ndarray          # (R_pow2, 400 + 80*n_sym_bucket, 2)
+    n_valid: np.ndarray           # (B,) int32 valid sample counts
+    n_sym: np.ndarray             # (B,) int32 true DATA symbol counts
+    rates_mbps: tuple             # (B,) the lanes' rates
+    n_sym_bucket: int
+
+
+def encode_many(psdus: Sequence, rates_mbps: Sequence[int],
+                add_fcs: bool = False) -> TxBatch:
+    """One-dispatch mixed-rate, mixed-length TX: N PSDUs encode as ONE
+    jitted ``vmap(lax.switch)`` at a common padded (bit-bucket,
+    symbol-bucket) geometry. Lane for lane bit-identical to per-frame
+    `encode_frame`; compile count is O(log bit buckets x log symbol
+    buckets), independent of how many (rate, length) combinations the
+    traffic mixes. The output stays device-resident — the loopback
+    link (phy/link.py) feeds it straight into the channel and
+    receiver without a host round trip."""
+    from ziria_tpu.utils import dispatch
+
+    if len(psdus) != len(rates_mbps):
+        raise ValueError(f"{len(psdus)} PSDUs but {len(rates_mbps)} "
+                         f"rates")
+    if not len(psdus):
+        raise ValueError("encode_many needs at least one frame")
+    bits_list = [_host_psdu_bits(p, add_fcs) for p in psdus]
+    n_sym = np.asarray([n_symbols(b.shape[0] // 8, RATES[m])
+                        for b, m in zip(bits_list, rates_mbps)],
+                       np.int32)
+    n_valid = (400 + 80 * n_sym).astype(np.int32)
+    bb = _bit_bucket(max(b.shape[0] for b in bits_list))
+    sb = max(_sym_bucket(int(s)) for s in n_sym)
+
+    lanes = pad_lanes(list(range(len(psdus))))
+    bits_b = np.zeros((len(lanes), bb), np.uint8)
+    nbits_b = np.zeros(len(lanes), np.int32)
+    ridx_b = np.zeros(len(lanes), np.int32)
+    for row, i in enumerate(lanes):
+        bits_b[row, :bits_list[i].shape[0]] = bits_list[i]
+        nbits_b[row] = bits_list[i].shape[0]
+        ridx_b[row] = RATE_INDEX[rates_mbps[i]]
+
+    dispatch.record("tx.encode_many")
+    samples = _jit_encode_many(bb, sb)(
+        jnp.asarray(bits_b), jnp.asarray(nbits_b), jnp.asarray(ridx_b))
+    return TxBatch(samples, n_valid, n_sym, tuple(rates_mbps), sb)
+
+
+def encode_batch(psdus, rate_mbps: int,
+                 add_fcs: bool = False) -> jnp.ndarray:
+    """Single-rate equal-length batch: (B, n_bytes) PSDUs -> (B,
+    frame_len, 2) device-resident frames in ONE dispatch, sliced to
+    the true frame length (every lane shares it). Bit-identical per
+    lane to `encode_frame` — the TX side of the BER waterfall sweep."""
+    from ziria_tpu.utils import dispatch
+
+    from ziria_tpu.utils.dispatch import pow2_ceil
+
+    psdus = np.asarray(psdus, np.uint8)
+    n_frames = psdus.shape[0]
+    bits = np.stack([_host_psdu_bits(p, add_fcs) for p in psdus])
+    n_bits = bits.shape[1]
+    n_sym = n_symbols(n_bits // 8, RATES[rate_mbps])
+    bb = _bit_bucket(n_bits)
+    bits_b = np.zeros((pow2_ceil(n_frames), bb), np.uint8)
+    bits_b[:n_frames, :n_bits] = bits
+    bits_b[n_frames:] = bits_b[0]
+    dispatch.record("tx.encode_batch")
+    out = _jit_encode_batch(rate_mbps, bb, _sym_bucket(n_sym))(
+        jnp.asarray(bits_b), jnp.int32(n_bits))
+    return out[:n_frames, :400 + 80 * n_sym]
+
+
 def encode_frame(psdu_bytes, rate_mbps: int,
                  add_fcs: bool = False) -> jnp.ndarray:
-    """Byte-level convenience wrapper. ``add_fcs`` appends the 32-bit
-    CRC (the reference TX's crc block) to the PSDU first."""
+    """Byte-level per-frame entry. ``add_fcs`` appends the 32-bit
+    CRC (the reference TX's crc block) to the PSDU first.
+
+    Dispatches through the lru-cached bucketed jit (one compiled
+    encoder per (rate, bit bucket, symbol bucket), sliced to the true
+    frame length) — bit-identical to the eager `encode_frame_bits`
+    graph, without the per-call re-trace. Traced inputs (callers
+    composing their own jit/vmap) fall through to the graph form."""
     rate = RATES[rate_mbps]
-    bits = bytes_to_bits(jnp.asarray(psdu_bytes, jnp.uint8))
-    if add_fcs:
-        bits = append_crc32(bits)
-    return encode_frame_bits(bits, rate)
+    if isinstance(psdu_bytes, jax.core.Tracer):
+        bits = bytes_to_bits(jnp.asarray(psdu_bytes, jnp.uint8))
+        if add_fcs:
+            bits = append_crc32(bits)
+        return encode_frame_bits(bits, rate)
+    from ziria_tpu.utils import dispatch
+
+    bits = _host_psdu_bits(psdu_bytes, add_fcs)
+    n_bits = bits.shape[0]
+    n_sym = n_symbols(n_bits // 8, rate)
+    bb = _bit_bucket(n_bits)
+    bits_pad = np.zeros(bb, np.uint8)
+    bits_pad[:n_bits] = bits
+    dispatch.record("tx.encode_frame")
+    out = _jit_encode_frame(rate_mbps, bb, _sym_bucket(n_sym))(
+        jnp.asarray(bits_pad), jnp.int32(n_bits))
+    return out[:400 + 80 * n_sym]
 
 
 # --------------------------------------------------------------------------

@@ -263,6 +263,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "acquisition loop (the batched path's "
                         "bit-identical oracle); also via "
                         "ZIRIA_BATCHED_ACQUIRE=0")
+    p.add_argument("--batched-tx", dest="batched_tx",
+                   action="store_true", default=None,
+                   help="one-dispatch batched TX for the frame-batch "
+                        "surfaces (tx.encode_many / link.loopback_many "
+                        "/ framebatch.transmit_many): an N-frame "
+                        "mixed-rate, mixed-length batch encodes as "
+                        "ONE vmapped lax.switch device call, and the "
+                        "loopback link runs TX->channel->RX in ~5 "
+                        "dispatches total (the default; "
+                        "docs/architecture.md). Also via "
+                        "ZIRIA_BATCHED_TX=1")
+    p.add_argument("--no-batched-tx", dest="batched_tx",
+                   action="store_false",
+                   help="force the per-frame encode/loopback loop "
+                        "(the batched TX path's bit-identical "
+                        "oracle); also via ZIRIA_BATCHED_TX=0")
     return p
 
 
@@ -609,6 +625,11 @@ def main(argv=None) -> int:
         # the viterbi pair above
         overrides["ZIRIA_BATCHED_ACQUIRE"] = \
             "1" if args.batched_acquire else "0"
+    if args.batched_tx is not None:
+        # link.batched_tx_enabled reads this at call time (the TX
+        # twin of the batched-acquire knob)
+        overrides["ZIRIA_BATCHED_TX"] = \
+            "1" if args.batched_tx else "0"
     if not overrides:
         return _main_run(args)
     prev = {k: os.environ.get(k) for k in overrides}

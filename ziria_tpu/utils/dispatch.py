@@ -24,6 +24,15 @@ both a first-class seam:
 Both are reentrant and thread-safe: nested/overlapping counters each
 see every event recorded while they are active (frame threads under
 ``framebatch.run_many`` all report into the same active counters).
+
+The module also owns the *dispatch geometry* helpers every batched
+path shares (:func:`pow2_ceil`, :func:`pow2_bucket`,
+:func:`pad_lanes`): lane counts and padded sizes round up to powers
+of two so XLA compiles O(log N) batch variants, not one per size —
+the single padding rule behind the O(log buckets) compile-count
+contracts the counters above measure. They were hoisted here from
+three drifting copies (``backend/framebatch``, ``rx.acquire_many``,
+and the TX batch path).
 """
 
 from __future__ import annotations
@@ -31,10 +40,36 @@ from __future__ import annotations
 import threading
 from collections import Counter
 from contextlib import contextmanager
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 _LOCK = threading.Lock()
 _ACTIVE: List["DispatchCount"] = []
+
+
+# ------------------------------------------------------ dispatch geometry
+
+
+def pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n (and >= 1)."""
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def pow2_bucket(n: int, min_bucket: int) -> int:
+    """Power-of-two size bucket with a floor: the one padding formula
+    every batched path uses (symbol buckets floor at 4, capture
+    buckets at 512, TX bit buckets at 128) so tiny inputs share one
+    compile class instead of fragmenting the jit caches."""
+    return max(int(min_bucket), pow2_ceil(n))
+
+
+def pad_lanes(lanes: Sequence) -> list:
+    """Pad a non-empty lane list to the next power-of-two count by
+    repeating lane 0 — the shared lane-count rule of every vmapped
+    batch here (XLA compiles O(log N) lane-count variants; repeated
+    lane 0 is discarded by the caller, which only reads the first
+    ``len(lanes)`` results)."""
+    lanes = list(lanes)
+    return lanes + [lanes[0]] * (pow2_ceil(len(lanes)) - len(lanes))
 
 
 class DispatchCount:
