@@ -1121,6 +1121,61 @@ def _child_main(run_id):
             note(f"link loopback stage failed: {e!r}")
             link_ev = {"error": repr(e)}
 
+    # ISSUE 4 tentpole evidence: the fused ONE-dispatch loopback graph
+    # vs the staged path (counts, per-site dispatch times, identity
+    # gate incl. batched CRC), and the one-scan BER sweep's points/s
+    # vs the per-batch python loop. Same resumable never-fatal stage
+    # discipline: the BENCH_* trajectory stays populated even when the
+    # backend flakes.
+    def _fused_link_stage():
+        if time.time() - t0 > 0.96 * budget:
+            raise TimeoutError("skipped: child time budget")
+        ev = _load_rx_dispatch_bench().fused_link_stats(
+            n_bytes=24 if os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+            else 100)
+        note(f"fused link: {ev['dispatches_staged']} dispatches / "
+             f"{ev['fps_staged']:.1f} fps -> "
+             f"{ev['dispatches_fused']} dispatch / "
+             f"{ev['fps_fused']:.1f} fps")
+        part("fused_link", **ev)
+        return ev
+
+    if "fused_link" in resume:
+        fused_ev = reuse(resume["fused_link"])
+        note("fused link resumed from prior window")
+    else:
+        try:
+            fused_ev = _fused_link_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"fused link stage failed: {e!r}")
+            fused_ev = {"error": repr(e)}
+
+    def _ber_sweep_stage():
+        if time.time() - t0 > 0.97 * budget:
+            raise TimeoutError("skipped: child time budget")
+        cpu = os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+        ev = _load_rx_dispatch_bench().ber_sweep_stats(
+            n_frames=8 if cpu else 16,
+            n_bytes=24 if cpu else 50,
+            rates=(6, 54) if cpu else (6, 24, 54))
+        note(f"ber sweep: {ev['points']} points, "
+             f"{ev['dispatches_loop']} loop dispatches -> "
+             f"{ev['dispatches_sweep']} "
+             f"({ev['points_per_s_sweep']:.2f} points/s, "
+             f"{ev['sweep_sps']:.0f} bit/s)")
+        part("ber_sweep", **ev)
+        return ev
+
+    if "ber_sweep" in resume:
+        sweep_ev = reuse(resume["ber_sweep"])
+        note("ber sweep resumed from prior window")
+    else:
+        try:
+            sweep_ev = _ber_sweep_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"ber sweep stage failed: {e!r}")
+            sweep_ev = {"error": repr(e)}
+
     def _percall_fence_stage():
         # per-call diagnostic (tunnel-dispatch-bound upper bound on
         # latency) — always taken at the base batch of 128, which may
@@ -1188,6 +1243,8 @@ def _child_main(run_id):
         "mixed_dispatch": mixed_ev,
         "batched_acquire": acq_ev,
         "link_loopback": link_ev,
+        "fused_link": fused_ev,
+        "ber_sweep": sweep_ev,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
         "resumed_stages": sorted(set(resumed_stages)),
     }

@@ -12,12 +12,14 @@ equalize/demap/deinterleave/Viterbi/descramble chain, not packet
 detection (detection robustness is exercised by the golden captures'
 impairments).
 
-The measurement rides the batched loopback's statistical lane
-(phy/link.loopback_ber_bits): frames encode in ONE device dispatch
-instead of N host-driven per-frame encodes — the same BERs (the TX
-batch is bit-identical lane for lane, the AWGN keys identical), a
-fraction of the tier-1 wall time. The pre-batched per-frame path is
-kept as the `slow` oracle lane, pinned EQUAL to the batched one.
+The measurement rides the device-resident sweep engine
+(phy/link.sweep_ber): each BER point is one `lax.scan` step of the
+perfect-sync link inside ONE compiled dispatch — the same BERs as the
+per-batch `loopback_ber_bits` path point for point (same TX bits,
+same AWGN keys; integer-identical error counts, pinned by
+tests/test_link_fused.py), a fraction of the per-point host round
+trips. The pre-batched per-frame path is kept as the `slow` oracle
+lane, pinned EQUAL to the batched one.
 """
 
 import numpy as np
@@ -41,12 +43,14 @@ def _ber_from_bits(got: np.ndarray, psdus: np.ndarray) -> float:
     return float(np.mean(got != want))
 
 
-def _ber_at(mbps: int, snr_db: float, seed: int,
-            batched_tx: bool = True) -> float:
-    psdus = _psdus(seed)
-    got = link.loopback_ber_bits(psdus, mbps, snr_db, seed,
-                                 batched_tx=batched_tx)
-    return _ber_from_bits(got, psdus)
+def _ber_at(mbps: int, snr_db: float, seed: int) -> float:
+    """One BER point through the sweep engine (a 1-point sweep: the
+    jitted scan compiles once per rate and every (snr, seed) after
+    that is a value, not a trace)."""
+    errs = link.sweep_ber(_psdus(seed), (mbps,), (snr_db,), (seed,))
+    return float(int(errs[0, 0, 0]) / (N_FRAMES * 8 * N_BYTES))
+
+
 
 
 @pytest.mark.slow
@@ -55,11 +59,13 @@ def test_perframe_oracle_lane_equals_batched(mbps, snr):
     """The pre-batched per-frame TX path (one encode_frame per frame)
     is the oracle the batched lane is judged against: same seeds, same
     AWGN keys, EQUAL BER — the frames are bit-identical, so the noisy
-    captures and the decode are too."""
+    captures and the decode are too. The sweep engine (the fast lane's
+    carrier) must agree with both at this full waterfall geometry."""
     psdus = _psdus(7)
     got_b = link.loopback_ber_bits(psdus, mbps, snr, 7, batched_tx=True)
     got_f = link.loopback_ber_bits(psdus, mbps, snr, 7, batched_tx=False)
     np.testing.assert_array_equal(got_b, got_f)
+    assert _ber_at(mbps, snr, 7) == _ber_from_bits(got_b, psdus)
 
 
 def _q(x):

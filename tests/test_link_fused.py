@@ -1,0 +1,265 @@
+"""The ONE-dispatch fused loopback link (phy/link loopback_fused path)
+and the device-resident BER sweep engine (link.sweep_ber[_sharded]):
+
+- fused vs staged bit-identity, lane for lane, across all 8 rates with
+  mixed lengths, finite SNR, per-lane CFO + delay, a swamped
+  (no-detect) lane, and ``check_fcs=True`` — the staged 5-dispatch
+  path is the oracle (itself pinned against the per-frame loop by
+  test_tx_batched.py);
+- the traced classify tree (`rx.classify_acquire_graph`) against the
+  host `_classify_acquire` over an exhaustive branch grid — no-detect,
+  short capture, flipped-parity SIGNAL, unknown rate, truncated
+  capture, decodable — so the failure classifications the loopback
+  cannot deterministically synthesize are pinned branch for branch;
+- the batched masked CRC against the per-lane host `check_crc32`
+  (boolean-identical, corruption detected), plus the dispatch-count
+  pin that `check_fcs=True` costs ONE extra dispatch, not one per
+  lane;
+- `sweep_ber` == python-loop-of-batches (integer-identical error
+  counts) at <= 1 dispatch vs >= 3 per point through the loop (and
+  >= 5 per point through the staged full link), and
+  `sweep_ber_sharded` == `sweep_ber` over the suite's 8-virtual-device
+  dp mesh.
+
+Budget discipline: ONE module fixture pays the fused-graph compile at
+the suite-shared 8-lane / 8-symbol-bucket geometry (same LENS/MBPS as
+test_tx_batched.py so the staged-side jits are shared), and the sweep
+tests use small frame geometries.
+"""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.phy import link
+from ziria_tpu.phy.wifi import rx
+from ziria_tpu.phy.wifi.params import RATES
+from ziria_tpu.utils import dispatch
+from ziria_tpu.utils.bits import np_bytes_to_bits
+
+LENS = (16, 10, 16, 5, 16, 12, 9, 16)
+MBPS = tuple(sorted(RATES))
+CFO = tuple((-1) ** k * 1e-4 * (k + 1) for k in range(8))
+DELAY = tuple(20 + 17 * k for k in range(8))
+# real AWGN with one swamped lane: the fused graph must classify the
+# no-detect lane exactly as the staged path does
+SNRS = (25.0, 30.0, -25.0, 28.0, 25.0, 30.0, 27.0, 26.0)
+SEED = 20260803
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """PSDUs + one fused and one staged loopback pass (finite per-lane
+    SNR + CFO + delay, FCS appended AND checked), each under a
+    dispatch counter."""
+    rng = np.random.default_rng(SEED)
+    psdus = [rng.integers(0, 256, n).astype(np.uint8) for n in LENS]
+    kw = dict(snr_db=SNRS, cfo=CFO, delay=DELAY, seed=11,
+              add_fcs=True, check_fcs=True)
+    with dispatch.count_dispatches() as d_fu:
+        got_fu = link.loopback_many(psdus, MBPS, fused=True, **kw)
+    with dispatch.count_dispatches() as d_st:
+        got_st = link.loopback_many(psdus, MBPS, fused=False, **kw)
+    return psdus, got_fu, got_st, d_fu, d_st
+
+
+def _same_result(a, b) -> bool:
+    return (a.ok == b.ok and a.rate_mbps == b.rate_mbps
+            and a.length_bytes == b.length_bytes
+            and np.array_equal(a.psdu_bits, b.psdu_bits)
+            and a.crc_ok == b.crc_ok)
+
+
+def test_fused_equals_staged_lane_for_lane(corpus):
+    # the acceptance contract: RxResults bit-identical lane for lane —
+    # all 8 rates, mixed lengths, finite SNR, CFO + delay, the
+    # no-detect lane, and CRC flags
+    psdus, got_fu, got_st, _d, _d2 = corpus
+    assert len(got_fu) == len(psdus)
+    for a, b in zip(got_fu, got_st):
+        assert _same_result(a, b)
+    assert not got_fu[2].ok            # the swamped lane really failed
+    for k in (0, 1, 3, 4, 5, 6, 7):    # the healthy lanes decode clean
+        assert got_fu[k].ok and got_fu[k].crc_ok
+        assert np.array_equal(
+            got_fu[k].psdu_bits[:8 * LENS[k]],
+            np_bytes_to_bits(psdus[k]))
+
+
+def test_fused_is_one_dispatch_even_with_fcs(corpus):
+    # the tentpole number: the whole mixed-rate multi-SNR batch —
+    # including the CRC check — is ONE instrumented device dispatch;
+    # the staged oracle pays ~5 plus ONE batched CRC dispatch (the
+    # satellite pin: not one check_crc32 dispatch per lane)
+    _psdus, _gf, _gs, d_fu, d_st = corpus
+    assert d_fu.total <= 1, dict(d_fu.counts)
+    assert d_fu.counts["link.fused"] == 1
+    assert d_st.total >= 5, dict(d_st.counts)
+    assert d_st.counts["rx.crc_many"] == 1, dict(d_st.counts)
+    # per-site wall times ride the same counter now (satellite 2)
+    assert d_st.times["rx.decode_mixed"] > 0.0
+    assert d_fu.times["link.fused"] > 0.0
+
+
+def test_fused_noise_free_and_compile_reuse(corpus):
+    # noise-free channel through the ALREADY-compiled fused geometry:
+    # zero fresh fused compiles (lru + jit reuse), still identical to
+    # staged, and a 7-lane batch pads back to the same 8-row graph
+    psdus, _gf, _gs, _d, _d2 = corpus
+    # add_fcs keeps the fixture's (bit bucket, symbol bucket) geometry
+    # so the lru-cached fused jit must be a pure reuse
+    kw = dict(snr_db=np.inf, cfo=CFO, delay=DELAY, seed=3,
+              add_fcs=True)
+    with dispatch.cache_growth(link._jit_fused_link) as g:
+        got_fu = link.loopback_many(psdus, MBPS, fused=True, **kw)
+        got_fu7 = link.loopback_many(
+            psdus[:7], MBPS[:7], fused=True, add_fcs=True,
+            snr_db=np.inf, cfo=CFO[:7], delay=DELAY[:7], seed=3)
+    assert g.total == 0, "fused geometry re-compiled"
+    got_st = link.loopback_many(psdus, MBPS, fused=False, **kw)
+    for a, b in zip(got_fu, got_st):
+        assert _same_result(a, b)
+    for a, b in zip(got_fu7, got_fu[:7]):
+        assert _same_result(a, b)
+
+
+def test_classify_graph_matches_host_tree_every_branch():
+    """The traced decision tree == the host tree, branch for branch:
+    no-detect, short capture, flipped-parity SIGNAL, unknown RATE
+    code, truncated capture, decodable — the failure classifications a
+    closed loopback cannot deterministically synthesize end-to-end are
+    pinned here at the decision-tree seam (the fused graph consumes
+    exactly these outputs)."""
+    import itertools
+
+    cases = list(itertools.product(
+        (False, True),                  # found
+        (0, 200, 400, 1040, 4096),      # avail
+        (0b1101, 0b0011, 0b0000, 0b1110, 15),   # rate_bits (2 invalid)
+        (0, 5, 16, 400, 4095),          # length_bytes
+        (False, True),                  # parity_ok
+    ))
+    found, avail, rb, ln, pk = (np.asarray(v) for v in zip(*cases))
+    st_g, mbps_g, len_g, nsym_g = (
+        np.asarray(x) for x in rx.classify_acquire_graph(
+            found, avail, rb, ln, pk))
+    from ziria_tpu.phy.wifi.params import n_symbols
+
+    statuses = set()
+    for k, (f, av, r, l, p) in enumerate(cases):
+        res, ok = rx._classify_acquire(f, av, r, l, p)
+        if ok is not None:
+            want = (rx.ACQ_DECODABLE, ok[0], l, ok[1])
+        elif res.rate_mbps:
+            want = (rx.ACQ_TRUNCATED, res.rate_mbps, res.length_bytes,
+                    n_symbols(res.length_bytes, RATES[res.rate_mbps]))
+        else:
+            want = (rx.ACQ_FAIL, 0, 0, 0)
+        got = (int(st_g[k]), int(mbps_g[k]), int(len_g[k]),
+               int(nsym_g[k]) if want[0] != rx.ACQ_FAIL else 0)
+        assert got == want, (cases[k], got, want)
+        statuses.add(got[0])
+    assert statuses == {rx.ACQ_FAIL, rx.ACQ_TRUNCATED,
+                        rx.ACQ_DECODABLE}   # every branch exercised
+
+
+def test_masked_crc_matches_host_crc():
+    import jax.numpy as jnp
+
+    from ziria_tpu.ops import crc
+
+    rng = np.random.default_rng(5)
+    for nb in (5, 16, 64):
+        bits = rng.integers(0, 2, 8 * nb).astype(np.uint8)
+        full = np.asarray(crc.append_crc32(bits))
+        pad = np.zeros(1024, np.uint8)
+        pad[:full.shape[0]] = full
+        good = bool(np.asarray(crc.check_crc32_masked(
+            jnp.asarray(pad), jnp.int32(full.shape[0]))))
+        assert good == bool(np.asarray(crc.check_crc32(full))) is True
+        # a single flipped bit anywhere in the body must fail, and a
+        # flipped PAD bit must NOT (the mask is the contract)
+        bad = pad.copy()
+        bad[int(rng.integers(0, full.shape[0]))] ^= 1
+        assert not bool(np.asarray(crc.check_crc32_masked(
+            jnp.asarray(bad), jnp.int32(full.shape[0]))))
+        padbit = pad.copy()
+        padbit[full.shape[0]] ^= 1
+        assert bool(np.asarray(crc.check_crc32_masked(
+            jnp.asarray(padbit), jnp.int32(full.shape[0]))))
+    # a stream too short to hold the FCS at all (a noise-corrupted
+    # SIGNAL claiming a 1..3-byte PSDU) must report False, never a
+    # garbage True from an underflowed byte count
+    ones = np.ones(1024, np.uint8)
+    for short in (0, 8, 24):
+        assert not bool(np.asarray(crc.check_crc32_masked(
+            jnp.asarray(ones), jnp.int32(short))))
+
+
+B_SWEEP, NB_SWEEP = 8, 24
+SWEEP_RATES = (6, 54)
+
+
+@pytest.fixture(scope="module")
+def sweep_corpus():
+    rng = np.random.default_rng(9)
+    psdus = rng.integers(0, 256, (B_SWEEP, NB_SWEEP)).astype(np.uint8)
+    # -2 dB sits in BPSK 1/2's transition even at short frames; 8 dB
+    # is comfortably clean (the waterfall suite pins the full curve)
+    snrs, seeds = (-2.0, 8.0), (7,)
+    with dispatch.count_dispatches() as d_sw:
+        errs = link.sweep_ber(psdus, SWEEP_RATES, snrs, seeds)
+    return psdus, snrs, seeds, errs, d_sw
+
+
+def test_sweep_ber_equals_perbatch_loop(sweep_corpus):
+    # integer-identical error counts vs the python loop of per-batch
+    # points, and the dispatch pin: ONE scan dispatch vs >= 3 per
+    # point through the loop (the staged full link would pay >= 5 per
+    # point — pinned by test_fused_is_one_dispatch_even_with_fcs's
+    # staged counter)
+    psdus, snrs, seeds, errs, d_sw = sweep_corpus
+    want = np.stack([np_bytes_to_bits(p) for p in psdus])
+    n_points = len(SWEEP_RATES) * len(snrs) * len(seeds)
+    with dispatch.count_dispatches() as d_lp:
+        for ri, m in enumerate(SWEEP_RATES):
+            for si, s in enumerate(snrs):
+                for ki, sd in enumerate(seeds):
+                    got = link.loopback_ber_bits(psdus, m, s, sd)
+                    assert int(np.sum(got != want)) == \
+                        int(errs[ri, si, ki]), (m, s, sd)
+    assert d_sw.total <= 1, dict(d_sw.counts)
+    assert d_sw.counts["link.sweep"] == 1
+    assert d_lp.total >= 3 * n_points, dict(d_lp.counts)
+    # the transition SNR really errors and the clean one is clean for
+    # the BPSK lane (the sweep is measuring, not echoing zeros)
+    assert errs[0, 0, 0] > 0 and errs[0, 1, 0] == 0
+
+
+def test_sweep_ber_sharded_identical_on_dp_mesh(sweep_corpus):
+    # the suite runs with 8 virtual devices (conftest): the dp-sharded
+    # sweep shards B_SWEEP lanes over frame_mesh() and must return the
+    # SAME integers (exact int sums — order-free); the real-chip pin
+    # is __graft_entry__.dryrun_multichip
+    import jax
+
+    psdus, snrs, seeds, errs, _d = sweep_corpus
+    from ziria_tpu.parallel.batch import frame_mesh
+
+    mesh = frame_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    errs_sh = link.sweep_ber_sharded(psdus, SWEEP_RATES, snrs, seeds,
+                                     mesh=mesh)
+    np.testing.assert_array_equal(errs, errs_sh)
+
+
+def test_fused_link_env_knob(monkeypatch):
+    # the CLI's scoped-env pattern: default ON, ZIRIA_FUSED_LINK=0
+    # forces the staged oracle, an explicit argument wins over the env
+    monkeypatch.delenv("ZIRIA_FUSED_LINK", raising=False)
+    assert link.fused_link_enabled(None)
+    monkeypatch.setenv("ZIRIA_FUSED_LINK", "0")
+    assert not link.fused_link_enabled(None)
+    assert link.fused_link_enabled(True)
+    monkeypatch.setenv("ZIRIA_FUSED_LINK", "1")
+    assert link.fused_link_enabled(None)
+    assert not link.fused_link_enabled(False)

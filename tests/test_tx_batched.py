@@ -39,12 +39,16 @@ SEED = 20260803
 def corpus():
     """PSDUs + one batched and one per-frame loopback pass (noise-free
     channel with per-lane CFO + delay), each under a dispatch
-    counter."""
+    counter. The batched pass pins ``fused=False`` throughout this
+    file: it is the STAGED-vs-perframe contract; the fused one-
+    dispatch graph is judged against the staged path in
+    tests/test_link_fused.py."""
     rng = np.random.default_rng(SEED)
     psdus = [rng.integers(0, 256, n).astype(np.uint8) for n in LENS]
     with dispatch.count_dispatches() as d_bat:
         got_b = link.loopback_many(psdus, MBPS, snr_db=np.inf, cfo=CFO,
-                                   delay=DELAY, seed=3, batched_tx=True)
+                                   delay=DELAY, seed=3, batched_tx=True,
+                                   fused=False)
     with dispatch.count_dispatches() as d_pf:
         got_f = link.loopback_many(psdus, MBPS, snr_db=np.inf, cfo=CFO,
                                    delay=DELAY, seed=3,
@@ -124,7 +128,7 @@ def test_loopback_dispatches_constant_in_batch_size(corpus):
             dispatch.count_dispatches() as d:
         got = link.loopback_many(psdus[:7], MBPS[:7], snr_db=np.inf,
                                  cfo=CFO[:7], delay=DELAY[:7], seed=3,
-                                 batched_tx=True)
+                                 batched_tx=True, fused=False)
     assert d.total <= 5
     assert g.total == 0
     for a, b in zip(got, got_b[:7]):
@@ -139,7 +143,8 @@ def test_noisy_and_failed_lanes_match_perframe(corpus):
     psdus, _gb, _gf, _db, _dp = corpus
     snrs = [25.0, 30.0, -25.0, 28.0, 25.0, 30.0, 27.0, 26.0]
     got_b = link.loopback_many(psdus, MBPS, snr_db=snrs, cfo=CFO,
-                               delay=DELAY, seed=11, batched_tx=True)
+                               delay=DELAY, seed=11, batched_tx=True,
+                               fused=False)
     got_f = link.loopback_many(psdus, MBPS, snr_db=snrs, cfo=CFO,
                                delay=DELAY, seed=11, batched_tx=False)
     for a, b in zip(got_b, got_f):

@@ -35,7 +35,23 @@ smoke or a manual chip window:
   the batched link vs >= 5N for the per-frame encode/impair/receive
   loop, identity-gated lane for lane; dispatch counts from the
   instrumented counter, so the artifact records the measured
-  O(N) -> O(1) collapse of the transmit side too.
+  O(N) -> O(1) collapse of the transmit side too. Pins
+  ``fused=False`` so this artifact keeps measuring the staging lever
+  alone, comparable with prior rounds; the fused graph is
+  ``fused_link_stats``'s job.
+
+- ``fused_link_stats`` (ISSUE 4 tentpole): the staged ~5-dispatch
+  loopback vs the ONE-dispatch fused graph (encode -> channel ->
+  acquire -> classify -> gather -> decode -> batched CRC in a single
+  jitted program), with ``check_fcs=True`` so the batched-CRC
+  satellite is measured too; per-site dispatch wall times from the
+  extended utils/dispatch counter, identity-gated lane for lane.
+
+- ``ber_sweep_stats`` (ISSUE 4 tentpole): an n-rates x K-SNR BER
+  sweep through ``link.sweep_ber`` (ONE lax.scan dispatch) vs the
+  python loop of per-batch ``loopback_ber_bits`` points (~3 dispatches
+  per point), error counts gated integer-identical, sweep points/s
+  and samples/s recorded.
 
 Standalone: ``ZIRIA_TOOL_ALLOW_CPU=1 python tools/rx_dispatch_bench.py``
 runs all at shrunk sizes on CPU (results labelled platform=cpu,
@@ -247,6 +263,8 @@ def batched_acquire_stats(n_bytes=100, viterbi_metric=None):
         "dispatches_host_acquire": d_host.total,
         "dispatches_batched_acquire": d_bat.total,
         "dispatch_breakdown_batched": dict(d_bat.counts),
+        "dispatch_times_ms_host": d_host.times_ms(),
+        "dispatch_times_ms_batched": d_bat.times_ms(),
         "t_host_acquire_s": round(t_host, 4),
         "t_batched_acquire_s": round(t_bat, 4),
         "sps_host_acquire": round(samples / t_host, 1),
@@ -273,7 +291,10 @@ def link_loopback_stats(n_frames=8, n_bytes=100, snr_db=28.0):
     psdus = [rng.integers(0, 256, n).astype(np.uint8) for n in lens]
     cfo = [(-1) ** k * 1e-4 * (k % 7 + 1) for k in range(n_frames)]
     delay = [20 + 13 * k for k in range(n_frames)]
-    kw = dict(snr_db=snr_db, cfo=cfo, delay=delay, seed=6)
+    # fused=False: this artifact measures the STAGING lever alone
+    # (comparable with prior rounds); fused_link_stats owns the fused
+    # graph's numbers
+    kw = dict(snr_db=snr_db, cfo=cfo, delay=delay, seed=6, fused=False)
 
     with count_dispatches() as d_pf:
         res_f = link.loopback_many(psdus, mbps, batched_tx=False, **kw)
@@ -296,11 +317,117 @@ def link_loopback_stats(n_frames=8, n_bytes=100, snr_db=28.0):
         "dispatches_perframe": d_pf.total,
         "dispatches_batched": d_bat.total,
         "dispatch_breakdown_batched": dict(d_bat.counts),
+        "dispatch_times_ms_batched": d_bat.times_ms(),
         "t_perframe_s": round(t_pf, 4),
         "t_batched_s": round(t_bat, 4),
         "fps_perframe": round(n_frames / t_pf, 1),
         "fps_batched": round(n_frames / t_bat, 1),
         "bit_identical": True,
+    }
+
+
+def fused_link_stats(n_frames=8, n_bytes=100, snr_db=28.0):
+    """The ONE-dispatch fused loopback graph vs its staged ~5-dispatch
+    oracle: dispatch counts AND per-site wall times (the extended
+    utils/dispatch counter), wall times, frames/s, and a lane-for-lane
+    identity gate — with ``check_fcs=True`` so the batched-CRC tail
+    (one vmapped dispatch instead of a host check per lane) is in the
+    measurement. Returns a flat dict."""
+    from ziria_tpu.phy import link
+    from ziria_tpu.phy.wifi.params import RATES
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    rng = np.random.default_rng(15)
+    mbps = (sorted(RATES) * (-(-n_frames // len(RATES))))[:n_frames]
+    lens = [max(5, n_bytes - 7 * (k % 5)) for k in range(n_frames)]
+    psdus = [rng.integers(0, 256, n).astype(np.uint8) for n in lens]
+    cfo = [(-1) ** k * 1e-4 * (k % 7 + 1) for k in range(n_frames)]
+    delay = [20 + 13 * k for k in range(n_frames)]
+    kw = dict(snr_db=snr_db, cfo=cfo, delay=delay, seed=6,
+              add_fcs=True, check_fcs=True)
+
+    with count_dispatches() as d_st:
+        res_s = link.loopback_many(psdus, mbps, fused=False, **kw)
+    t_st = _timed(lambda: link.loopback_many(
+        psdus, mbps, fused=False, **kw))
+
+    with count_dispatches() as d_fu:
+        res_f = link.loopback_many(psdus, mbps, fused=True, **kw)
+    t_fu = _timed(lambda: link.loopback_many(
+        psdus, mbps, fused=True, **kw))
+
+    assert all(a.ok == b.ok and a.crc_ok == b.crc_ok
+               and a.rate_mbps == b.rate_mbps
+               and a.length_bytes == b.length_bytes
+               and np.array_equal(a.psdu_bits, b.psdu_bits)
+               for a, b in zip(res_s, res_f)), \
+        "fused loopback diverged from the staged path"
+
+    return {
+        "frames": n_frames, "max_frame_bytes": max(lens),
+        "rates": sorted(set(mbps)), "snr_db": snr_db,
+        "check_fcs": True,
+        "dispatches_staged": d_st.total,
+        "dispatches_fused": d_fu.total,
+        "dispatch_breakdown_staged": dict(d_st.counts),
+        "dispatch_times_ms_staged": d_st.times_ms(),
+        "dispatch_times_ms_fused": d_fu.times_ms(),
+        "t_staged_s": round(t_st, 4),
+        "t_fused_s": round(t_fu, 4),
+        "fps_staged": round(n_frames / t_st, 1),
+        "fps_fused": round(n_frames / t_fu, 1),
+        "bit_identical": True,
+    }
+
+
+def ber_sweep_stats(n_frames=16, n_bytes=50, rates=(6, 24, 54),
+                    snrs=(2.0, 5.0, 8.0), seeds=(7,)):
+    """A rates x SNR x seeds BER sweep through `link.sweep_ber` (ONE
+    lax.scan dispatch) vs the python loop of per-batch
+    `loopback_ber_bits` points (~3 instrumented dispatches per
+    rate-point), error counts gated integer-identical. Records sweep
+    points/s and samples/s. Returns a flat dict."""
+    from ziria_tpu.phy import link
+    from ziria_tpu.utils.bits import np_bytes_to_bits
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    rng = np.random.default_rng(16)
+    psdus = rng.integers(0, 256, (n_frames, n_bytes)).astype(np.uint8)
+    want = np.stack([np_bytes_to_bits(p) for p in psdus])
+
+    with count_dispatches() as d_sw:
+        errs = link.sweep_ber(psdus, rates, snrs, seeds)
+    t_sw = _timed(lambda: link.sweep_ber(psdus, rates, snrs, seeds))
+
+    with count_dispatches() as d_lp:
+        for ri, m in enumerate(rates):
+            for si, s in enumerate(snrs):
+                for ki, sd in enumerate(seeds):
+                    got = link.loopback_ber_bits(psdus, m, s, sd)
+                    e = int(np.sum(got != want))
+                    assert e == int(errs[ri, si, ki]), \
+                        "sweep diverged from the per-batch loop"
+    t_lp = _timed(lambda: [
+        link.loopback_ber_bits(psdus, m, s, sd)
+        for m in rates for s in snrs for sd in seeds])
+
+    n_points = len(rates) * len(snrs) * len(seeds)
+    bits_per_point = n_frames * 8 * n_bytes
+    return {
+        "frames": n_frames, "frame_bytes": n_bytes,
+        "rates": list(rates), "snrs": list(snrs),
+        "seeds": list(seeds), "points": n_points,
+        "dispatches_sweep": d_sw.total,
+        "dispatches_loop": d_lp.total,
+        "dispatch_times_ms_sweep": d_sw.times_ms(),
+        "t_sweep_s": round(t_sw, 4),
+        "t_loop_s": round(t_lp, 4),
+        "points_per_s_sweep": round(n_points / t_sw, 2),
+        "points_per_s_loop": round(n_points / t_lp, 2),
+        "bits_per_point": bits_per_point,
+        "sweep_sps": round(
+            n_points * bits_per_point / max(t_sw, 1e-9), 1),
+        "counts_identical": True,
     }
 
 
@@ -322,6 +449,9 @@ def main():
         out["mixed_dispatch"] = mixed_dispatch_stats(n_bytes=60)
         out["batched_acquire"] = batched_acquire_stats(n_bytes=60)
         out["link_loopback"] = link_loopback_stats(n_bytes=24)
+        out["fused_link"] = fused_link_stats(n_bytes=24)
+        out["ber_sweep"] = ber_sweep_stats(
+            n_frames=8, n_bytes=24, rates=(6, 54), snrs=(3.0, 8.0))
     else:
         out["quantized"] = quantized_sweep()
         out["mixed_dispatch"] = mixed_dispatch_stats()
@@ -329,6 +459,8 @@ def main():
             viterbi_metric="int16")
         out["batched_acquire"] = batched_acquire_stats()
         out["link_loopback"] = link_loopback_stats()
+        out["fused_link"] = fused_link_stats()
+        out["ber_sweep"] = ber_sweep_stats()
     print(json.dumps(out))
     return 0
 

@@ -265,16 +265,19 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
                        results: List[Any], check_fcs: bool,
                        viterbi_window, viterbi_metric):
     """The shared tail of every batched receive surface: ONE
-    mixed-rate decode dispatch over the lane-padded segments, then the
-    per-lane PSDU slice/CRC. `acqs` is [(i, acq)] for the real lanes
-    (acq needs .rate_mbps/.n_sym/.length_bytes — both the host
-    `_Acquired` and batched `_LaneAcq` shapes qualify); `padded` is
-    THE pad_lanes list the caller built `segs` from — passed in, not
-    recomputed, so the ridx/nbits rows can never disagree with the
-    segment rows."""
+    mixed-rate decode dispatch over the lane-padded segments, plus —
+    when FCS checking is on — ONE vmapped masked-CRC dispatch at the
+    common bucket over the still-device-resident decode output
+    (previously a hidden host `check_crc32` dispatch PER LANE), then
+    the per-lane PSDU slice. CRC booleans are bit-identical to the
+    per-lane path (`ops/crc.check_crc32_masked` is the same table
+    scan, masked). `acqs` is [(i, acq)] for the real lanes (acq needs
+    .rate_mbps/.n_sym/.length_bytes — both the host `_Acquired` and
+    batched `_LaneAcq` shapes qualify); `padded` is THE pad_lanes
+    list the caller built `segs` from — passed in, not recomputed, so
+    the ridx/nbits rows can never disagree with the segment rows."""
     import jax.numpy as jnp
 
-    from ziria_tpu.ops.crc import check_crc32
     from ziria_tpu.phy.wifi import rx as _rx
     from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
     from ziria_tpu.utils import dispatch
@@ -286,12 +289,19 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
         jnp.int32)
     dec = _rx._jit_decode_data_mixed(n_sym_b, viterbi_window,
                                      viterbi_metric)
-    dispatch.record("rx.decode_mixed")
-    clear = np.asarray(dec(segs, ridx, nbits), np.uint8)
+    with dispatch.timed("rx.decode_mixed"):
+        clear_dev = dec(segs, ridx, nbits)
+    crc_b = None
+    if check_fcs:
+        npsdu = jnp.asarray([8 * a.length_bytes for _i, a in padded],
+                            jnp.int32)
+        with dispatch.timed("rx.crc_many"):
+            crc_b = np.asarray(_rx._jit_crc_many()(clear_dev, npsdu))
+    clear = np.asarray(clear_dev, np.uint8)
     for k, (i, a) in enumerate(acqs):
         psdu = clear[k][N_SERVICE_BITS: N_SERVICE_BITS
                         + 8 * a.length_bytes]
-        crc = bool(np.asarray(check_crc32(psdu))) if check_fcs else None
+        crc = bool(crc_b[k]) if check_fcs else None
         results[i] = _rx.RxResult(True, a.rate_mbps, a.length_bytes,
                                   psdu, crc)
     return results
@@ -347,8 +357,9 @@ def transmit_many(psdus, rates_mbps, add_fcs: bool = False,
 
 def loopback_many(psdus, rates_mbps, **kw) -> List[Any]:
     """The full device-resident N-frame loopback (thin re-export of
-    phy/link.loopback_many): encode -> per-lane channel -> batched
-    receive in ~5 dispatches total."""
+    phy/link.loopback_many): ONE fused dispatch by default, or the
+    staged encode -> per-lane channel -> batched receive ~5-dispatch
+    oracle under ``fused=False`` / ``ZIRIA_FUSED_LINK=0``."""
     from ziria_tpu.phy import link
     return link.loopback_many(psdus, rates_mbps, **kw)
 

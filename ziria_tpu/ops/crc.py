@@ -67,6 +67,55 @@ def check_crc32(bits) -> jnp.ndarray:
     return jnp.all(crc32_bits(body) == fcs)
 
 
+def crc32_bytes_masked(data, n_bytes) -> jnp.ndarray:
+    """CRC-32 of the first ``n_bytes`` (TRACED int32) of a padded uint8
+    byte array: the same table-driven ``lax.scan`` as
+    :func:`crc32_bytes`, with steps at or past ``n_bytes`` leaving the
+    register untouched — so one fixed-length compiled scan serves every
+    true length, and a batch of mixed-length streams rides one ``vmap``
+    (the batched-FCS dispatch of ``framebatch._mixed_decode_tail`` and
+    the fused loopback link). Bit-identical to ``crc32_bytes`` of the
+    unpadded prefix."""
+    data = jnp.asarray(data, jnp.uint8)
+    tab = jnp.asarray(_TABLE)
+    n_bytes = jnp.asarray(n_bytes, jnp.int32)
+
+    def step(crc, ji):
+        j, byte = ji
+        idx = (crc ^ byte.astype(jnp.uint32)) & 0xFF
+        nxt = (crc >> 8) ^ tab[idx]
+        return jnp.where(j < n_bytes, nxt, crc), None
+
+    crc, _ = jax.lax.scan(
+        step, jnp.uint32(0xFFFFFFFF),
+        (jnp.arange(data.shape[0], dtype=jnp.int32), data))
+    return crc ^ jnp.uint32(0xFFFFFFFF)
+
+
+def check_crc32_masked(bits, n_bits) -> jnp.ndarray:
+    """Traced-length twin of :func:`check_crc32`: ``bits`` is a padded
+    bit stream whose first ``n_bits`` (TRACED int32, a multiple of 8)
+    are body+FCS; returns True iff bits[n_bits-32 : n_bits] is the
+    FCS of bits[: n_bits-32]. Fixed shapes — one compile per padded
+    length, every true length and (under ``vmap``) every lane of a
+    mixed-length batch served by it.
+
+    A stream too short to even hold the 32-bit FCS (n_bits < 32 — a
+    noise-corrupted SIGNAL claiming a 1..3-byte PSDU) reports False:
+    no valid FCS can exist. (The eager :func:`check_crc32` cannot
+    classify that case at all — its fixed slices raise a shape error —
+    so this is the one place the masked twin is defined on strictly
+    more inputs rather than bit-identical.)"""
+    bits = jnp.asarray(bits, jnp.uint8)
+    n_bits = jnp.asarray(n_bits, jnp.int32)
+    crc = crc32_bytes_masked(bits_to_bytes(bits),
+                             jnp.maximum(n_bits - 32, 0) // 8)
+    fcs = jax.lax.dynamic_slice(
+        bits, (jnp.maximum(n_bits - 32, 0),), (32,))
+    return jnp.logical_and(n_bits >= 32,
+                           jnp.all(uint_to_bits(crc, 32) == fcs))
+
+
 def np_crc32_bits_ref(bits: np.ndarray) -> np.ndarray:
     """Independent oracle: per-bit LFSR, straight from the CRC definition.
     Used only by tests."""

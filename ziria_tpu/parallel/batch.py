@@ -4,7 +4,10 @@ The reference has no batch axis at all (streams are sequential,
 SURVEY.md §2.4); independent frames across a TPU mesh is the new
 capability that buys the headline throughput: `pjit` shards the frame
 axis over 'dp', every chip decodes its shard, no collectives needed in
-steady state (only at host gather).
+steady state (only at host gather). `phy/link.sweep_ber_sharded`
+rides exactly this pattern for the serving workload: the BER sweep's
+frame-lane axis placed with :func:`shard_batch`, every chip sweeping
+its shard of lanes, ONE integer all-reduce per sweep for the counts.
 """
 
 from __future__ import annotations
@@ -27,10 +30,15 @@ def frame_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def lane_sharding(mesh: Mesh, ndim: int, axis: str = "dp") -> NamedSharding:
+    """The ONE placement rule of every dp surface: leading (frame/lane)
+    axis sharded over `axis`, everything else replicated."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
 def shard_batch(mesh: Mesh, x, axis: str = "dp"):
     """Place `x` with its leading (frame) axis sharded over `axis`."""
-    spec = P(axis, *([None] * (np.ndim(x) - 1)))
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.device_put(x, lane_sharding(mesh, np.ndim(x), axis))
 
 
 def data_parallel(fn: Callable, mesh: Mesh, axis: str = "dp") -> Callable:
@@ -41,11 +49,9 @@ def data_parallel(fn: Callable, mesh: Mesh, axis: str = "dp") -> Callable:
     runs each chip's shard independently — the |>>>|-free scale-out path.
     """
 
-    def in_sharding(a):
-        return NamedSharding(mesh, P(axis, *([None] * (np.ndim(a) - 1))))
-
     def run(*args):
-        shardings = jax.tree.map(in_sharding, args)
+        shardings = jax.tree.map(
+            lambda a: lane_sharding(mesh, np.ndim(a), axis), args)
         return jax.jit(fn, in_shardings=shardings)(*args)
 
     return run

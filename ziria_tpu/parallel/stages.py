@@ -47,7 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ziria_tpu.utils.compat import shard_map
 
 from ziria_tpu.core import ir
 from ziria_tpu.core.card import TCard, cardinality

@@ -101,20 +101,30 @@ def lane_key(seed, i):
     return jax.random.fold_in(jax.random.PRNGKey(seed), i)
 
 
+def impair_many_graph(x_b, n_valid, snr_db, eps, delay, seed,
+                      out_len: int) -> jnp.ndarray:
+    """The traced batched channel: pad the TX batch to `out_len`,
+    derive per-lane keys from `seed` by counter fold-in, and apply
+    every lane's own impairments under one ``vmap`` — the graph
+    `_jit_impair_many` jits, exposed as a plain function so larger
+    programs can FUSE it (the one-dispatch loopback link traces it
+    between the batch encode and the batched receiver)."""
+    pad = out_len - x_b.shape[1]
+    x = jnp.pad(jnp.asarray(x_b, jnp.float32),
+                ((0, 0), (0, pad), (0, 0)))
+    keys = jax.vmap(lambda i: lane_key(seed, i))(
+        jnp.arange(x.shape[0]))
+    return jax.vmap(impair_graph)(x, n_valid, snr_db, eps, delay,
+                                  keys)
+
+
 @lru_cache(maxsize=None)
 def _jit_impair_many(out_len: int):
-    """ONE jitted vmapped channel per output length (jit retraces per
-    input shape): pads the TX batch to `out_len`, derives per-lane
-    keys by counter fold-in, and applies every lane's own impairments
-    in one dispatch."""
+    """ONE jitted `impair_many_graph` per output length (jit retraces
+    per input shape)."""
     def f(x_b, n_valid, snr_db, eps, delay, seed):
-        pad = out_len - x_b.shape[1]
-        x = jnp.pad(jnp.asarray(x_b, jnp.float32),
-                    ((0, 0), (0, pad), (0, 0)))
-        keys = jax.vmap(lambda i: lane_key(seed, i))(
-            jnp.arange(x.shape[0]))
-        return jax.vmap(impair_graph)(x, n_valid, snr_db, eps, delay,
-                                      keys)
+        return impair_many_graph(x_b, n_valid, snr_db, eps, delay,
+                                 seed, out_len)
     return jax.jit(f)
 
 
@@ -136,11 +146,11 @@ def impair_many(x_b, n_valid, snr_db, eps, delay, seed,
         a = np.broadcast_to(np.asarray(v, dtype), (r,))
         return jnp.asarray(a)
 
-    dispatch.record("channel.impair_many")
-    return _jit_impair_many(int(out_len))(
-        x_b, _vec(n_valid, np.int32), _vec(snr_db, np.float32),
-        _vec(eps, np.float32), _vec(delay, np.int32),
-        jnp.uint32(seed))
+    with dispatch.timed("channel.impair_many"):
+        return _jit_impair_many(int(out_len))(
+            x_b, _vec(n_valid, np.int32), _vec(snr_db, np.float32),
+            _vec(eps, np.float32), _vec(delay, np.int32),
+            jnp.uint32(seed))
 
 
 @lru_cache(maxsize=None)
@@ -159,10 +169,10 @@ def impair_one(samples, snr_db, eps, delay, seed, lane: int,
     x = np.zeros((int(out_len), 2), np.float32)
     s = np.asarray(samples, np.float32)
     x[:s.shape[0]] = s
-    dispatch.record("channel.impair")
-    return _jit_impair_one()(
-        jnp.asarray(x), jnp.int32(s.shape[0]), jnp.float32(snr_db),
-        jnp.float32(eps), jnp.int32(delay), lane_key(seed, lane))
+    with dispatch.timed("channel.impair"):
+        return _jit_impair_one()(
+            jnp.asarray(x), jnp.int32(s.shape[0]), jnp.float32(snr_db),
+            jnp.float32(eps), jnp.int32(delay), lane_key(seed, lane))
 
 
 def multipath(samples, taps_pair) -> jnp.ndarray:

@@ -3,31 +3,43 @@
 The closed loop the reference ran over SORA/BladeRF hardware (Sora's
 NSDI 2009 real-time link; the Ziria transceiver demo drives it
 in-language) — here the "air" is the batched synthetic channel and the
-whole N-frame round trip compiles to a handful of device programs:
+whole N-frame round trip compiles to ONE device program:
 
-    tx.encode_many          ONE vmap(lax.switch) mixed-rate encode
-    channel.impair_many     ONE vmapped per-lane AWGN/CFO/delay
-    rx.acquire_batch        ONE vmapped detect/align/CFO/SIGNAL
-    rx.gather_segments_many ONE gather+derotate at the common bucket
-    rx.decode_data_mixed    ONE mixed-rate DATA decode
+    link.loopback_fused     encode_many → impair_many → acquire →
+                            classify → gather → mixed decode →
+                            batched CRC, fused into ONE jitted graph
 
-— ~5 device dispatches for any N-frame, all-rates, multi-SNR batch,
-with the sample arrays staying device-resident between stages (the
-TX batch never crosses the host link until the decoded bits come
-back). That makes BER-waterfall-style sweeps — this repo's serving
-workload — O(1)-dispatch in the batch size.
+— 1 device dispatch for any N-frame, all-rates, multi-SNR batch. The
+host `_classify_acquire` decision tree is pure integer logic, so in
+the loopback — where the frame geometry is already known from the TX
+side and the SIGNAL parse is therefore NOT data-dependent — it traces
+(`rx.classify_acquire_graph`) and no acquisition metadata crosses the
+host link mid-batch; the decoded SIGNAL fields come back as device-
+side validity flags, so no-detect / bad-parity / truncated lanes keep
+their exact staged-path classification.
 
-``batched_tx=False`` (or ``--no-batched-tx`` / ``ZIRIA_BATCHED_TX=0``
-through the CLI's scoped-env pattern) runs the per-frame oracle loop
-instead: encode_frame + single-lane channel + rx.receive per frame,
->= 5 dispatches per lane — bit-identical lane for lane to the batched
-path (tests/test_tx_batched.py pins it; tools/rx_dispatch_bench.py
-``link_loopback_stats`` measures it).
+``fused=False`` (or ``--no-fused-link`` / ``ZIRIA_FUSED_LINK=0``) runs
+the STAGED 5-dispatch path — encode_many, impair_many, then the
+acquire → gather → mixed-decode triple — the fused graph's
+bit-identical oracle (same capture bucket, so the noise draws agree);
+``batched_tx=False`` (``--no-batched-tx`` / ``ZIRIA_BATCHED_TX=0``)
+drops further to the per-frame loop: encode_frame + single-lane
+channel + rx.receive per frame, >= 5 dispatches per lane. All three
+agree lane for lane (tests/test_link_fused.py, test_tx_batched.py;
+tools/rx_dispatch_bench.py ``fused_link_stats`` measures it).
+
+On top of the fused step, ``sweep_ber`` runs an entire BER waterfall —
+(rate grid) x (SNR grid x seeds) — as ONE ``lax.scan`` dispatch with a
+donated error-count carry, and ``sweep_ber_sharded`` shards its frame
+lanes over ``parallel/batch.frame_mesh``'s dp axis so the sweep scales
+across chips (integer error counts, so the numbers are identical on 1
+device and any mesh).
 """
 
 from __future__ import annotations
 
 import os
+from functools import lru_cache
 from typing import List, Optional, Sequence
 
 import jax
@@ -37,7 +49,10 @@ import numpy as np
 from ziria_tpu.backend import framebatch
 from ziria_tpu.phy import channel
 from ziria_tpu.phy.wifi import rx, tx
-from ziria_tpu.phy.wifi.params import RATES, n_symbols
+from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, \
+    RATE_MBPS_ORDER, RATES, n_symbols
+from ziria_tpu.utils import dispatch
+from ziria_tpu.utils.dispatch import pad_lanes, pow2_ceil
 
 
 def batched_tx_enabled(batched_tx: Optional[bool] = None) -> bool:
@@ -46,6 +61,15 @@ def batched_tx_enabled(batched_tx: Optional[bool] = None) -> bool:
     if batched_tx is not None:
         return batched_tx
     return os.environ.get("ZIRIA_BATCHED_TX", "1") != "0"
+
+
+def fused_link_enabled(fused: Optional[bool] = None) -> bool:
+    """The ONE reading of the --fused-link / ZIRIA_FUSED_LINK knob
+    (default ON): whether `loopback_many` routes through the
+    one-dispatch fused graph or the staged 5-dispatch oracle."""
+    if fused is not None:
+        return fused
+    return os.environ.get("ZIRIA_FUSED_LINK", "1") != "0"
 
 
 def transmit_many(psdus: Sequence, rates_mbps: Sequence[int],
@@ -71,23 +95,84 @@ def _lane_param(v, n: int, dtype) -> np.ndarray:
     return np.broadcast_to(np.asarray(v, dtype), (n,)).copy()
 
 
-def loopback_many(psdus: Sequence, rates_mbps: Sequence[int],
+def _link_buckets(psdus, rates_mbps, add_fcs: bool, dly_max: int):
+    """The ONE derivation of the link's (symbol bucket, capture
+    bucket): the common symbol bucket's frame length plus the worst
+    delay, at the receiver's capture-bucket rule. Every loopback mode
+    — fused, staged, per-frame — calls this, because a lane's noise
+    field is drawn over the whole capture buffer: buffer sizes ARE
+    semantics, and a drift here would silently break the lane-for-lane
+    bit-identity contract."""
+    fcs_bytes = 4 if add_fcs else 0
+    sym_b = max(tx._sym_bucket(n_symbols(
+        int(np.asarray(p).size) + fcs_bytes, RATES[m]))
+        for p, m in zip(psdus, rates_mbps))
+    return sym_b, rx._stream_bucket(400 + 80 * sym_b + int(dly_max))
+
+
+class _LinkGeometry:
+    """The host-known batch geometry of the staged/fused loopback: the
+    shared TX batch prep (`tx.batch_host_prep` — the SAME padded-batch
+    rule `encode_many` consumes, so the link can never drift from the
+    transmit surfaces) plus the link-side row tables (channel params,
+    capture bucket, per-lane decode bit counts)."""
+
+    def __init__(self, psdus, rates_mbps, snr, eps, dly, add_fcs):
+        n = len(psdus)
+        self.n = n
+        prep = tx.batch_host_prep(psdus, rates_mbps, add_fcs)
+        self.n_sym = prep.n_sym
+        self.sym_b = prep.n_sym_bucket
+        self.bit_b = prep.bit_bucket
+        self.bits_b = prep.bits_b
+        self.nbits_b = prep.nbits_b
+        self.ridx_b = prep.ridx_b
+        _sym_b2, self.l_cap = _link_buckets(psdus, rates_mbps,
+                                            add_fcs, int(dly.max()))
+        if _sym_b2 != self.sym_b:       # one rule, two call shapes
+            raise AssertionError(
+                f"link bucket rule drifted: {_sym_b2} != {self.sym_b}")
+        self.rows = pow2_ceil(n)
+        lanes = pad_lanes(list(range(n)))
+        self.nv_tx = np.zeros(self.rows, np.int32)
+        self.ndata_b = np.zeros(self.rows, np.int32)
+        for row, i in enumerate(lanes):
+            self.nv_tx[row] = 400 + 80 * int(self.n_sym[i])
+            self.ndata_b[row] = int(self.n_sym[i]) * \
+                RATES[rates_mbps[i]].n_dbps
+
+        def _pad_rows(a):
+            return np.concatenate(
+                [a, np.broadcast_to(a[0], (self.rows - n,)
+                                    + a.shape[1:])])
+        self.snr = _pad_rows(snr)
+        self.eps = _pad_rows(eps)
+        self.dly = _pad_rows(dly)
+
+
+def loopback_many(psdus, rates_mbps: Sequence[int],
                   snr_db=np.inf, cfo=0.0, delay=0, seed: int = 0,
                   add_fcs: bool = False, check_fcs: bool = False,
                   batched_tx: Optional[bool] = None,
+                  fused: Optional[bool] = None,
                   viterbi_window: int = None,
                   viterbi_metric: str = None) -> List:
-    """The full N-frame mixed-rate loopback: encode → per-lane channel
-    impairments → batched acquire → gather → mixed-rate decode, in ~5
-    device dispatches total, arrays device-resident between stages.
+    """The full N-frame mixed-rate loopback. Default: the FUSED path —
+    encode → per-lane channel impairments → acquire → classify →
+    gather → mixed-rate decode → batched CRC as ONE jitted device
+    program (1 dispatch). ``fused=False`` / ``ZIRIA_FUSED_LINK=0``:
+    the staged ~5-dispatch path (encode_many + impair_many + the
+    acquire/gather/decode triple), the fused graph's bit-identical
+    oracle. ``batched_tx=False``: the per-frame loop (>= 5 dispatches
+    per lane), the staged path's oracle in turn.
 
     ``snr_db``/``cfo``/``delay`` are scalars or per-lane sequences
     (``np.inf`` SNR disables noise exactly); lane noise keys derive
     from ``seed`` by counter fold-in, so lane i sees the same channel
-    whether it runs batched or alone. Returns per-frame
-    :class:`rx.RxResult`, lane-for-lane bit-identical to the per-frame
-    oracle loop (``batched_tx=False``: encode_frame + single-lane
-    `channel.impair_graph` + `rx.receive` per frame)."""
+    whether it runs fused, staged, or alone. Returns per-frame
+    :class:`rx.RxResult`, lane-for-lane bit-identical across all three
+    modes — including no-detect / bad-parity / truncated lanes and
+    ``check_fcs=True``."""
     n = len(psdus)
     if len(rates_mbps) != n:
         raise ValueError(f"{n} PSDUs but {len(rates_mbps)} rates")
@@ -98,17 +183,10 @@ def loopback_many(psdus: Sequence, rates_mbps: Sequence[int],
     dly = _lane_param(delay, n, np.int32)
     if (dly < 0).any():
         raise ValueError("negative delay")
-    # ONE capture length for the whole link, batched or not: the
-    # common symbol bucket's frame length plus the worst delay, at the
-    # receiver's capture-bucket rule. The per-frame oracle MUST use
-    # the same length — a lane's noise field is drawn over the whole
-    # buffer, so per-lane buffer sizes would change the draws and the
-    # bit-identity contract with the batched path.
-    fcs_bytes = 4 if add_fcs else 0
-    sym_b = max(tx._sym_bucket(n_symbols(
-        int(np.asarray(p).size) + fcs_bytes, RATES[m]))
-        for p, m in zip(psdus, rates_mbps))
-    l_cap = rx._stream_bucket(400 + 80 * sym_b + int(dly.max()))
+    # the shared bucket rule, from byte counts alone — the per-frame
+    # oracle never pays the padded-batch construction
+    _sym_b, l_cap = _link_buckets(psdus, rates_mbps, add_fcs,
+                                  int(dly.max()))
 
     if not batched_tx_enabled(batched_tx):
         # the per-frame oracle: same channel physics, one frame at a
@@ -125,23 +203,139 @@ def loopback_many(psdus: Sequence, rates_mbps: Sequence[int],
                                       viterbi_metric=viterbi_metric))
         return results
 
-    txb = tx.encode_many(psdus, rates_mbps, add_fcs=add_fcs)
-    rows = int(txb.samples.shape[0])
-    assert int(txb.samples.shape[1]) == 400 + 80 * sym_b
-    nv_tx = np.full((rows,), txb.n_valid[0], np.int32)
-    nv_tx[:n] = txb.n_valid
+    geo = _LinkGeometry(psdus, rates_mbps, snr, eps, dly, add_fcs)
+    if fused_link_enabled(fused):
+        return _loopback_fused(geo, seed, check_fcs,
+                               viterbi_window, viterbi_metric)
+    return _loopback_staged(geo, seed, check_fcs, viterbi_window,
+                            viterbi_metric)
 
-    def _pad_rows(a):
-        out = np.concatenate([a, np.broadcast_to(a[0], (rows - n,)
-                                                 + a.shape[1:])])
-        return out
 
+def _loopback_staged(geo: _LinkGeometry, seed, check_fcs,
+                     viterbi_window, viterbi_metric) -> List:
+    """The staged ~5-dispatch batched loopback (the fused graph's
+    bit-identical oracle): one encode_many dispatch, one impair_many
+    dispatch, then receive_many_device's acquire → gather → decode
+    (+ CRC) over the device-resident capture batch."""
+    with dispatch.timed("tx.encode_many"):
+        samples = tx._jit_encode_many(geo.bit_b, geo.sym_b)(
+            jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
+            jnp.asarray(geo.ridx_b))
     caps = channel.impair_many(
-        txb.samples, nv_tx, _pad_rows(snr), _pad_rows(eps),
-        _pad_rows(dly), seed, out_len=l_cap)
+        samples, geo.nv_tx, geo.snr, geo.eps, geo.dly, seed,
+        out_len=geo.l_cap)
     return framebatch.receive_many_device(
-        caps, n, check_fcs=check_fcs, viterbi_window=viterbi_window,
-        viterbi_metric=viterbi_metric)
+        caps, geo.n, check_fcs=check_fcs,
+        viterbi_window=viterbi_window, viterbi_metric=viterbi_metric)
+
+
+@lru_cache(maxsize=None)
+def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
+                    l_cap: int, viterbi_window: int = None,
+                    viterbi_metric: str = None):
+    """ONE compiled loopback link per (lane count, bit bucket, symbol
+    bucket, capture bucket, decode mode): the whole TX → channel → RX
+    chain — including the acquisition classify tree and the batched
+    FCS check — as a single XLA program. The CRC flags are always
+    computed (a ~200-byte masked scan per lane — noise next to the
+    Viterbi), so one compile serves both ``check_fcs`` modes."""
+    need_b = rx.FRAME_DATA_START + 80 * sym_bucket
+
+    def f(bits_b, nbits_b, ridx_b, nv_tx, snr, eps, dly, seed,
+          ndata_b):
+        # 1. mixed-rate encode at the common bucketed geometry
+        samples = tx.encode_many_graph(bits_b, nbits_b, ridx_b,
+                                       sym_bucket)
+        # 2. per-lane channel impairments (counter fold-in keys:
+        #    lane i's noise is the same fused, staged, or alone)
+        caps = channel.impair_many_graph(samples, nv_tx, snr, eps,
+                                         dly, seed, l_cap)
+        # 3. batched acquisition: detect / LTS timing / CFO / SIGNAL
+        #    (the whole capture is the lane's buffer, so n_valid and
+        #    the detector's position cap are both l_cap — exactly what
+        #    receive_many_device passes)
+        nv = jnp.full((caps.shape[0],), l_cap, jnp.int32)
+        found, start, eps_hat, rate_bits, length, parity_ok = \
+            jax.vmap(rx.acquire_frame_graph)(caps, nv, nv)
+        # 4. the classify tree, traced — the host decision that used
+        #    to force a sync point stays on-device
+        status, mbps_sig, len_sig, nsym_sig = rx.classify_acquire_graph(
+            found, nv - start, rate_bits, length, parity_ok)
+        # 5. gather+derotate EVERY lane at the common symbol bucket
+        #    (failed lanes produce garbage segments, masked by status
+        #    host-side; per-lane values are batch-independent)
+        caps_pad = jnp.pad(caps, ((0, 0), (0, need_b), (0, 0)))
+        segs = jax.vmap(
+            lambda xi, s, e, a: rx.gather_segment_graph(
+                xi, s, e, a, sym_bucket))(caps_pad, start, eps_hat,
+                                          nv - start)
+        # 6. mixed-rate DATA decode at the TX-known geometry (the
+        #    loopback's SIGNAL parse is not data-dependent: rate and
+        #    bit count per lane are known a priori; the decoded
+        #    SIGNAL only gates validity via `status`)
+        clear = rx.decode_data_mixed(segs, ridx_b, ndata_b, sym_bucket,
+                                     viterbi_window, viterbi_metric)
+        # 7. batched FCS check over the decoded PSDUs
+        crc_ok = rx.crc_psdu_many_graph(clear, nbits_b)
+        return status, mbps_sig, len_sig, nsym_sig, clear, crc_ok
+
+    return jax.jit(f)
+
+
+def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
+                    viterbi_window, viterbi_metric) -> List:
+    """Host wrapper of the fused graph: ONE device dispatch, then the
+    per-lane RxResult assembly from the returned validity flags —
+    integer reads only, exactly mirroring `_classify_acquire`'s
+    outcomes. If a decodable lane's decoded SIGNAL disagrees with the
+    TX-side geometry (possible only when noise corrupts the SIGNAL
+    into a *different valid* header — a 1-in-2^~16 parity escape), the
+    fused decode geometry would diverge from the staged one, so the
+    whole batch falls back to the staged oracle; the common case pays
+    nothing for the guard."""
+    fn = _jit_fused_link(geo.rows, geo.bit_b, geo.sym_b, geo.l_cap,
+                         viterbi_window, viterbi_metric)
+    with dispatch.timed("link.fused"):
+        status, mbps_sig, len_sig, nsym_sig, clear, crc_ok = fn(
+            jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
+            jnp.asarray(geo.ridx_b), jnp.asarray(geo.nv_tx),
+            jnp.asarray(geo.snr), jnp.asarray(geo.eps),
+            jnp.asarray(geo.dly), jnp.uint32(seed),
+            jnp.asarray(geo.ndata_b))
+    status = np.asarray(status)
+    mbps_sig = np.asarray(mbps_sig)
+    len_sig = np.asarray(len_sig)
+    nsym_sig = np.asarray(nsym_sig)
+
+    results: List = [None] * geo.n
+    clear_np = None
+    crc_np = None
+    for i in range(geo.n):
+        st = int(status[i])
+        if st == rx.ACQ_FAIL:
+            results[i] = rx.RxResult(False, 0, 0,
+                                     np.zeros(0, np.uint8), None)
+            continue
+        m, ln = int(mbps_sig[i]), int(len_sig[i])
+        if st == rx.ACQ_TRUNCATED:
+            results[i] = rx.RxResult(False, m, ln,
+                                     np.zeros(0, np.uint8), None)
+            continue
+        if (m != RATE_MBPS_ORDER[int(geo.ridx_b[i])]
+                or 8 * ln != int(geo.nbits_b[i])
+                or int(nsym_sig[i]) != int(geo.n_sym[i])):
+            # SIGNAL decoded to a different valid header than the one
+            # TX sent: the staged path would decode at ITS claimed
+            # geometry — replay the batch through the oracle
+            return _loopback_staged(geo, seed, check_fcs,
+                                    viterbi_window, viterbi_metric)
+        if clear_np is None:
+            clear_np = np.asarray(clear, np.uint8)
+            crc_np = np.asarray(crc_ok) if check_fcs else None
+        psdu = clear_np[i][N_SERVICE_BITS: N_SERVICE_BITS + 8 * ln]
+        crc = bool(crc_np[i]) if check_fcs else None
+        results[i] = rx.RxResult(True, m, ln, psdu, crc)
+    return results
 
 
 def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
@@ -152,7 +346,9 @@ def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
     (`tx.encode_batch`; per-frame `encode_frame` loop when batched TX
     is off — bit-identical), AWGN rides one vmapped dispatch with
     per-lane split keys, and the batched DATA decode returns the
-    decoded PSDU bits (B, 8*n_bytes)."""
+    decoded PSDU bits (B, 8*n_bytes). `sweep_ber` is the ONE-dispatch
+    sweep of exactly this step over a (SNR x seed) grid — equal error
+    counts point for point."""
     psdus = np.asarray(psdus, np.uint8)
     rate = RATES[rate_mbps]
     n_bytes = psdus.shape[1]
@@ -163,7 +359,143 @@ def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
         frames = jnp.stack([jnp.asarray(tx.encode_frame(p, rate_mbps))
                             for p in psdus])
     keys = jax.random.split(jax.random.PRNGKey(seed), psdus.shape[0])
-    noisy = jax.vmap(
-        lambda k, f: channel.awgn(k, f, snr_db))(keys, frames)
-    got, _ = rx.decode_data_batch(noisy, rate, n_sym, 8 * n_bytes)
+    with dispatch.timed("channel.awgn_batch"):
+        noisy = jax.vmap(
+            lambda k, f: channel.awgn(k, f, snr_db))(keys, frames)
+    with dispatch.timed("rx.decode_batch"):
+        got, _ = rx.decode_data_batch(noisy, rate, n_sym, 8 * n_bytes)
     return np.asarray(got)
+
+
+# ------------------------------------------------- device-resident sweeps
+#
+# The serving workload: BER / waterfall studies over (rate, SNR, seed)
+# grids. Point-by-point through the per-batch path every point pays
+# the host round trips; here the whole grid rides ONE compiled
+# `lax.scan` whose carry — the error-count buffer — is donated, and
+# whose per-point body is the same perfect-sync step as
+# `loopback_ber_bits` (same split keys, same ops), so the counts agree
+# integer-for-integer with a python loop of batches.
+
+
+def _sweep_point_graph(frames_by_rate, want_bits, rate_list, snr, seed):
+    """One sweep point, traced: AWGN at `snr` with keys split from
+    `seed` (the SAME key schedule as loopback_ber_bits — lane i's
+    noise never depends on which rates ride the sweep), the batched
+    DATA decode per rate, and integer error counts vs the known TX
+    bits. Returns (n_rates,) int32."""
+    errs = []
+    for frames, (m, n_sym, n_psdu_bits) in zip(frames_by_rate,
+                                               rate_list):
+        keys = jax.random.split(jax.random.PRNGKey(seed),
+                                frames.shape[0])
+        noisy = jax.vmap(
+            lambda k, f, _s=snr: channel.awgn(k, f, _s))(keys, frames)
+        got, _ = rx.decode_data_batch(noisy, RATES[m], n_sym,
+                                      n_psdu_bits)
+        errs.append(jnp.sum(got != want_bits, dtype=jnp.int32))
+    return jnp.stack(errs)
+
+
+@lru_cache(maxsize=None)
+def _jit_sweep_ber(rates_key: tuple, n_bytes: int, donate: bool):
+    """ONE compiled sweep per (rate tuple, frame bytes): encode every
+    rate's frame batch once (scan-invariant — XLA hoists it), then
+    `lax.scan` the point step over the (snr, seed) grid, writing each
+    point's error counts into the carried buffer. The buffer is
+    DONATED (where the backend supports donation), so repeated sweeps
+    reuse its pages instead of allocating per call."""
+    rate_list = tuple(
+        (m, n_symbols(n_bytes, RATES[m]), 8 * n_bytes)
+        for m in rates_key)
+
+    def f(bits_b, snr_flat, seed_flat, errbuf):
+        # bits_b doubles as the decode's expected output: the TX bits
+        # ARE the truth the decoded PSDU is scored against (one upload,
+        # one traced operand)
+        frames_by_rate = []
+        for m, n_sym, _nb in rate_list:
+            rate = RATES[m]
+            full = jax.vmap(
+                lambda b, _r=rate, _sb=tx._sym_bucket(n_sym):
+                tx.encode_frame_bits_bucketed(
+                    b, jnp.int32(8 * n_bytes), _r, _sb))(bits_b)
+            frames_by_rate.append(full[:, :400 + 80 * n_sym])
+
+        def body(carry, xs):
+            i, buf = carry
+            snr, seed = xs
+            e = _sweep_point_graph(frames_by_rate, bits_b,
+                                   rate_list, snr, seed)
+            buf = jax.lax.dynamic_update_slice(
+                buf, e[None], (i, jnp.int32(0)))
+            return (i + 1, buf), None
+
+        (_, buf), _ = jax.lax.scan(
+            body, (jnp.int32(0), errbuf), (snr_flat, seed_flat))
+        return buf
+
+    return jax.jit(f, donate_argnums=(3,) if donate else ())
+
+
+def sweep_ber(psdus, rates_mbps: Sequence[int],
+              snr_grid: Sequence[float], seeds: Sequence[int],
+              _shard=None) -> np.ndarray:
+    """An entire BER waterfall in ONE device dispatch: every rate in
+    `rates_mbps` over every (snr, seed) point of the grid, via one
+    `lax.scan` of the perfect-sync link step. Returns int64 error
+    counts shaped (len(rates), len(snr_grid), len(seeds)); divide by
+    ``psdus.shape[0] * 8 * psdus.shape[1]`` for BER. Counts are
+    IDENTICAL to a python loop of `loopback_ber_bits` batches over the
+    same points (pinned by tests/test_link_fused.py) — vs ~3 host
+    round trips per point through that loop and ~5 per point through
+    the staged full link.
+
+    `_shard` (internal — `sweep_ber_sharded` passes it) is a callable
+    placing the lane-axis arrays on a device mesh before the call."""
+    psdus = np.asarray(psdus, np.uint8)
+    if psdus.ndim != 2:
+        raise ValueError("psdus must be (B, n_bytes)")
+    b, n_bytes = psdus.shape
+    rates_key = tuple(int(m) for m in rates_mbps)
+    bits = np.stack([tx._host_psdu_bits(p, False) for p in psdus])
+    snrs = np.asarray(snr_grid, np.float32)
+    seed_arr = np.asarray(seeds, np.int32)
+    # the scanned point order is (snr major, seed minor)
+    snr_flat = np.repeat(snrs, seed_arr.shape[0])
+    seed_flat = np.tile(seed_arr, snrs.shape[0])
+    n_points = snr_flat.shape[0]
+    errbuf = jnp.zeros((n_points, len(rates_key)), jnp.int32)
+    bits_d = jnp.asarray(bits)
+    if _shard is not None:
+        bits_d = _shard(bits_d)
+    donate = jax.devices()[0].platform != "cpu"   # no-op (+warn) on CPU
+    with dispatch.timed("link.sweep"):
+        out = _jit_sweep_ber(rates_key, n_bytes, donate)(
+            bits_d, jnp.asarray(snr_flat),
+            jnp.asarray(seed_flat), errbuf)
+        errs = np.asarray(out, np.int64)
+    return np.transpose(
+        errs.reshape(snrs.shape[0], seed_arr.shape[0],
+                     len(rates_key)), (2, 0, 1))
+
+
+def sweep_ber_sharded(psdus, rates_mbps: Sequence[int],
+                      snr_grid: Sequence[float], seeds: Sequence[int],
+                      mesh=None, axis: str = "dp") -> np.ndarray:
+    """`sweep_ber` with the frame-lane axis sharded over a device mesh
+    (`parallel/batch.frame_mesh()` by default — every visible chip):
+    each device encodes/impairs/decodes its shard of lanes, XLA
+    inserts the error-count reduction. Error counts are exact integer
+    sums, so the result is bit-identical to the single-device sweep on
+    ANY mesh shape — on 1 device this IS `sweep_ber` — and the frame
+    batch must divide the mesh (`shard_batch`'s rule). The MULTICHIP
+    dryrun (`__graft_entry__.dryrun_multichip`) pins the multi-device
+    path; `parallel/batch.data_parallel` is the same placement pattern
+    this reuses."""
+    from ziria_tpu.parallel import batch as pbatch
+
+    if mesh is None:
+        mesh = pbatch.frame_mesh()
+    return sweep_ber(psdus, rates_mbps, snr_grid, seeds,
+                     _shard=lambda x: pbatch.shard_batch(mesh, x, axis))

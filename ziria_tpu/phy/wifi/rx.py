@@ -302,6 +302,28 @@ def decode_data_mixed(frames, rate_idx, n_bits_real, n_sym_bucket: int,
     return jax.vmap(_descramble)(bits)
 
 
+def crc_psdu_many_graph(clear_b, n_psdu_bits):
+    """Batched FCS check over the mixed decode's output: for each lane
+    of `clear_b` (B, n_sym_bucket * MAX_DBPS descrambled bit streams)
+    with `n_psdu_bits` (B,) traced true PSDU bit counts, True iff the
+    PSDU's trailing 32 bits are the CRC-32 of the rest — ONE vmapped
+    masked-scan CRC at the common bucket instead of a host
+    `check_crc32` dispatch per lane (`ops/crc.check_crc32_masked`),
+    boolean-identical lane for lane. Traced, so the fused loopback
+    link inlines it after the decode."""
+    from ziria_tpu.ops.crc import check_crc32_masked
+
+    return jax.vmap(check_crc32_masked)(
+        clear_b[:, N_SERVICE_BITS:], jnp.asarray(n_psdu_bits, jnp.int32))
+
+
+@lru_cache(maxsize=None)
+def _jit_crc_many():
+    """ONE jitted batched FCS check serving every (lane count, bucket)
+    geometry (jit retraces per shape)."""
+    return jax.jit(crc_psdu_many_graph)
+
+
 @lru_cache(maxsize=None)
 def _jit_decode_data_mixed(n_sym_bucket: int, viterbi_window: int = None,
                            viterbi_metric: str = None):
@@ -379,7 +401,10 @@ def _classify_acquire(found: bool, avail: int, rate_bits: int,
     any failure, (None, (rate_mbps, n_sym)) for a decodable frame.
 
     All length checks use the true capture length — decoding padding
-    zeros as DATA must fail, not silently "succeed"."""
+    zeros as DATA must fail, not silently "succeed".
+    `classify_acquire_graph` is the traced twin the fused loopback
+    link runs on-device; their branch-for-branch agreement is pinned
+    by tests/test_link_fused.py."""
     fail = RxResult(False, 0, 0, np.zeros(0, np.uint8), None)
     if not found or avail < 400 or not parity_ok:
         return fail, None
@@ -393,6 +418,52 @@ def _classify_acquire(found: bool, avail: int, rate_bits: int,
     return None, (rate_mbps, n_sym)
 
 
+# 16-entry lookup tables over the 4-bit SIGNAL RATE field: mbps (0 for
+# the 8 invalid codes) and n_dbps — what lets `classify_acquire_graph`
+# run `SIGNAL_BITS_TO_MBPS.get` + `n_symbols` as traced integer ops
+_RB_TO_MBPS = np.zeros(16, np.int32)
+_RB_TO_DBPS = np.zeros(16, np.int32)
+for _rb, _m in SIGNAL_BITS_TO_MBPS.items():
+    _RB_TO_MBPS[_rb] = _m
+    _RB_TO_DBPS[_rb] = RATES[_m].n_dbps
+
+# classification codes shared by the traced tree and its host readers
+ACQ_FAIL, ACQ_TRUNCATED, ACQ_DECODABLE = 0, 1, 2
+
+
+def classify_acquire_graph(found, avail, rate_bits, length_bytes,
+                           parity_ok):
+    """The traced twin of `_classify_acquire` — the same pure-integer
+    decision tree as jnp ops, so the fused loopback link keeps it
+    on-device (no acquisition metadata crosses the host link mid-
+    batch). All inputs traced, elementwise over any batch shape.
+
+    Returns ``(status, rate_mbps, length_bytes, n_sym)``:
+    status `ACQ_FAIL` (no detect / short capture / bad parity /
+    unknown rate; rate/length forced 0 exactly as the host tree's fail
+    RxResult), `ACQ_TRUNCATED` (SIGNAL parsed but the capture can't
+    hold the claimed DATA field; rate/length are the parsed values),
+    or `ACQ_DECODABLE`."""
+    rb = jnp.asarray(rate_bits, jnp.uint32) & 15
+    mbps = jnp.asarray(_RB_TO_MBPS)[rb]
+    dbps = jnp.asarray(_RB_TO_DBPS)[rb]
+    avail = jnp.asarray(avail, jnp.int32)
+    length_bytes = jnp.asarray(length_bytes, jnp.int32)
+    known = (jnp.asarray(found, bool) & (avail >= 400)
+             & jnp.asarray(parity_ok, bool) & (mbps > 0))
+    n_bits = N_SERVICE_BITS + 8 * length_bytes + N_TAIL_BITS
+    n_sym = (n_bits + dbps - 1) // jnp.maximum(dbps, 1)
+    fits = avail >= FRAME_DATA_START + 80 * n_sym
+    status = jnp.where(known,
+                       jnp.where(fits, ACQ_DECODABLE, ACQ_TRUNCATED),
+                       ACQ_FAIL)
+    zero = jnp.zeros_like(mbps)
+    return (jnp.asarray(status, jnp.int32),
+            jnp.where(known, mbps, zero),
+            jnp.where(known, length_bytes, zero),
+            jnp.where(known, n_sym, zero))
+
+
 def _acquire_frame(samples, max_samples: int = 1 << 16):
     """Detect/align/CFO-correct a capture and parse its SIGNAL field:
     the per-capture acquisition front of `receive` — and the single-
@@ -402,8 +473,8 @@ def _acquire_frame(samples, max_samples: int = 1 << 16):
 
     x, n_valid = _bucket_pad(
         np.asarray(samples, np.float32)[:max_samples])
-    dispatch.record("rx.sync")
-    found, start, eps = _jit_sync_fn()(x)
+    with dispatch.timed("rx.sync"):
+        found, start, eps = _jit_sync_fn()(x)
     found = bool(np.asarray(found))
     start = int(np.asarray(start))
     eps = float(np.asarray(eps))
@@ -415,10 +486,11 @@ def _acquire_frame(samples, max_samples: int = 1 << 16):
         # the 400-sample head now, the (rate, n_sym)-sized data region
         # after the SIGNAL parse (both slices start at the frame
         # start, keeping the rotation phase-continuous)
-        dispatch.record("rx.cfo_head")
-        head = sync.correct_cfo(jnp.asarray(x[start:start + 400]), eps)
-        dispatch.record("rx.signal")
-        rb, ln, pk = _jit_signal_fn()(head)
+        with dispatch.timed("rx.cfo_head"):
+            head = sync.correct_cfo(jnp.asarray(x[start:start + 400]),
+                                    eps)
+        with dispatch.timed("rx.signal"):
+            rb, ln, pk = _jit_signal_fn()(head)
         rate_bits = int(np.asarray(rb))
         length_bytes = int(np.asarray(ln))
         parity_ok = bool(np.asarray(pk))
@@ -490,10 +562,10 @@ def acquire_batch(x_dev, n_valid, limits, n_lanes: int):
     acquisition without ever crossing the host link."""
     from ziria_tpu.utils import dispatch
 
-    dispatch.record("rx.acquire_many")
-    found_b, start_b, eps_b, rb_b, ln_b, pk_b = _jit_acquire_many()(
-        x_dev, jnp.asarray(n_valid, jnp.int32),
-        jnp.asarray(limits, jnp.int32))
+    with dispatch.timed("rx.acquire_many"):
+        found_b, start_b, eps_b, rb_b, ln_b, pk_b = _jit_acquire_many()(
+            x_dev, jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(limits, jnp.int32))
     found_b = np.asarray(found_b)
     start_b = np.asarray(start_b)
     eps_b = np.asarray(eps_b)
@@ -607,13 +679,13 @@ def gather_segments_many(x_dev, lanes, n_sym_bucket: int):
     (repeat the first entry, like every batch path here)."""
     from ziria_tpu.utils import dispatch
 
-    dispatch.record("rx.gather")
-    return _jit_gather_segments(n_sym_bucket)(
-        x_dev,
-        jnp.asarray([la.row for la in lanes], jnp.int32),
-        jnp.asarray([la.start for la in lanes], jnp.int32),
-        jnp.asarray([la.eps for la in lanes], jnp.float32),
-        jnp.asarray([la.avail for la in lanes], jnp.int32))
+    with dispatch.timed("rx.gather"):
+        return _jit_gather_segments(n_sym_bucket)(
+            x_dev,
+            jnp.asarray([la.row for la in lanes], jnp.int32),
+            jnp.asarray([la.start for la in lanes], jnp.int32),
+            jnp.asarray([la.eps for la in lanes], jnp.float32),
+            jnp.asarray([la.avail for la in lanes], jnp.int32))
 
 
 def _padded_segment(acq: _Acquired, n_sym_bucket: int):
@@ -628,8 +700,8 @@ def _padded_segment(acq: _Acquired, n_sym_bucket: int):
     frame_pad = np.zeros((need_b, 2), np.float32)
     n = min(acq.avail, need_b)
     frame_pad[:n] = acq.frame_np[:n]
-    dispatch.record("rx.cfo_segment")
-    return sync.correct_cfo(jnp.asarray(frame_pad), acq.eps)
+    with dispatch.timed("rx.cfo_segment"):
+        return sync.correct_cfo(jnp.asarray(frame_pad), acq.eps)
 
 
 def receive(samples, check_fcs: bool = False,
@@ -678,9 +750,9 @@ def receive(samples, check_fcs: bool = False,
                                     None if fxp else viterbi_window,
                                     None if fxp else viterbi_metric)
     from ziria_tpu.utils import dispatch
-    dispatch.record("rx.decode_bucketed")
-    clear = np.asarray(
-        dec(seg, jnp.int32(acq.n_sym * rate.n_dbps)), np.uint8)
+    with dispatch.timed("rx.decode_bucketed"):
+        clear = np.asarray(
+            dec(seg, jnp.int32(acq.n_sym * rate.n_dbps)), np.uint8)
     psdu = clear[N_SERVICE_BITS: N_SERVICE_BITS + 8 * acq.length_bytes]
     crc = bool(np.asarray(check_crc32(psdu))) if check_fcs else None
     return RxResult(True, acq.rate_mbps, acq.length_bytes, psdu, crc)

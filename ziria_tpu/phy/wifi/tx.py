@@ -212,22 +212,37 @@ def _jit_encode_batch(rate_mbps: int, bit_bucket: int,
     return jax.jit(f)
 
 
-@lru_cache(maxsize=None)
-def _jit_encode_many(bit_bucket: int, n_sym_bucket: int):
-    """ONE jitted ``vmap(lax.switch)`` over all 8 per-rate bucketed
-    encoders per (bit bucket, symbol bucket) geometry — the TX twin of
-    rx._jit_decode_data_mixed. Under vmap the switch lowers to a
-    select over the branches; each lane's samples come from its own
-    rate's encoder, bit-identical to the single-rate trace."""
+def encode_many_graph(bits_b, nbits_b, ridx_b,
+                      n_sym_bucket: int) -> jnp.ndarray:
+    """The traced mixed-rate batch encode: ``vmap(lax.switch)`` over
+    all 8 per-rate bucketed encoders at one symbol-bucket geometry —
+    the graph `_jit_encode_many` jits, exposed as a plain function so
+    larger programs can FUSE it (the one-dispatch loopback link traces
+    it inline with the channel and receiver). bits_b (R, bit_bucket)
+    zero-padded PSDU bits, nbits_b/ridx_b (R,) int32 true bit counts
+    and RATE_MBPS_ORDER indices, all traced. Returns
+    (R, 400 + 80*n_sym_bucket, 2); each lane's first
+    400 + 80*n_symbols(real) samples are bit-identical to
+    `encode_frame`."""
     branches = [
         (lambda b, n, _r=RATES[m]: encode_frame_bits_bucketed(
             b, n, _r, n_sym_bucket))
         for m in RATE_MBPS_ORDER]
+    return jax.vmap(
+        lambda b, n, r: jax.lax.switch(r, branches, b, n))(
+            bits_b, jnp.asarray(nbits_b, jnp.int32),
+            jnp.asarray(ridx_b, jnp.int32))
 
+
+@lru_cache(maxsize=None)
+def _jit_encode_many(bit_bucket: int, n_sym_bucket: int):
+    """ONE jitted `encode_many_graph` per (bit bucket, symbol bucket)
+    geometry — the TX twin of rx._jit_decode_data_mixed. Under vmap
+    the switch lowers to a select over the branches; each lane's
+    samples come from its own rate's encoder, bit-identical to the
+    single-rate trace."""
     def f(bits_b, nbits_b, ridx_b):
-        return jax.vmap(
-            lambda b, n, r: jax.lax.switch(r, branches, b, n))(
-                bits_b, nbits_b, ridx_b)
+        return encode_many_graph(bits_b, nbits_b, ridx_b, n_sym_bucket)
 
     return jax.jit(f)
 
@@ -253,6 +268,51 @@ class TxBatch(NamedTuple):
     n_sym_bucket: int
 
 
+class TxHostPrep(NamedTuple):
+    """The host-side batch prep every mixed-rate TX surface shares —
+    THE one place the padded-batch rule lives (`encode_many` consumes
+    it; the loopback link's `_LinkGeometry` wraps it, so the fused /
+    staged / per-frame bit-identity contract can never be broken by
+    the two drifting apart)."""
+    bits_list: list               # per-lane true PSDU(+FCS) bits
+    n_sym: np.ndarray             # (B,) int32 true DATA symbol counts
+    bit_bucket: int
+    n_sym_bucket: int
+    bits_b: np.ndarray            # (R_pow2, bit_bucket) padded rows
+    nbits_b: np.ndarray           # (R_pow2,) int32 true bit counts
+    ridx_b: np.ndarray            # (R_pow2,) int32 RATE_MBPS_ORDER idx
+
+
+def batch_host_prep(psdus: Sequence, rates_mbps: Sequence[int],
+                    add_fcs: bool = False) -> TxHostPrep:
+    """Byte PSDUs -> the padded (bit-bucket, symbol-bucket) batch
+    arrays of the mixed-rate encode: bits (FCS appended when asked),
+    per-lane symbol counts, the common buckets, and pad_lanes-rule
+    rows (lane 0 repeated to the next power of two)."""
+    if len(psdus) != len(rates_mbps):
+        raise ValueError(f"{len(psdus)} PSDUs but {len(rates_mbps)} "
+                         f"rates")
+    if not len(psdus):
+        raise ValueError("need at least one frame")
+    bits_list = [_host_psdu_bits(p, add_fcs) for p in psdus]
+    n_sym = np.asarray([n_symbols(b.shape[0] // 8, RATES[m])
+                        for b, m in zip(bits_list, rates_mbps)],
+                       np.int32)
+    bb = _bit_bucket(max(b.shape[0] for b in bits_list))
+    sb = max(_sym_bucket(int(s)) for s in n_sym)
+
+    lanes = pad_lanes(list(range(len(psdus))))
+    bits_b = np.zeros((len(lanes), bb), np.uint8)
+    nbits_b = np.zeros(len(lanes), np.int32)
+    ridx_b = np.zeros(len(lanes), np.int32)
+    for row, i in enumerate(lanes):
+        bits_b[row, :bits_list[i].shape[0]] = bits_list[i]
+        nbits_b[row] = bits_list[i].shape[0]
+        ridx_b[row] = RATE_INDEX[rates_mbps[i]]
+    return TxHostPrep(bits_list, n_sym, bb, sb, bits_b, nbits_b,
+                      ridx_b)
+
+
 def encode_many(psdus: Sequence, rates_mbps: Sequence[int],
                 add_fcs: bool = False) -> TxBatch:
     """One-dispatch mixed-rate, mixed-length TX: N PSDUs encode as ONE
@@ -265,32 +325,14 @@ def encode_many(psdus: Sequence, rates_mbps: Sequence[int],
     receiver without a host round trip."""
     from ziria_tpu.utils import dispatch
 
-    if len(psdus) != len(rates_mbps):
-        raise ValueError(f"{len(psdus)} PSDUs but {len(rates_mbps)} "
-                         f"rates")
-    if not len(psdus):
-        raise ValueError("encode_many needs at least one frame")
-    bits_list = [_host_psdu_bits(p, add_fcs) for p in psdus]
-    n_sym = np.asarray([n_symbols(b.shape[0] // 8, RATES[m])
-                        for b, m in zip(bits_list, rates_mbps)],
-                       np.int32)
-    n_valid = (400 + 80 * n_sym).astype(np.int32)
-    bb = _bit_bucket(max(b.shape[0] for b in bits_list))
-    sb = max(_sym_bucket(int(s)) for s in n_sym)
-
-    lanes = pad_lanes(list(range(len(psdus))))
-    bits_b = np.zeros((len(lanes), bb), np.uint8)
-    nbits_b = np.zeros(len(lanes), np.int32)
-    ridx_b = np.zeros(len(lanes), np.int32)
-    for row, i in enumerate(lanes):
-        bits_b[row, :bits_list[i].shape[0]] = bits_list[i]
-        nbits_b[row] = bits_list[i].shape[0]
-        ridx_b[row] = RATE_INDEX[rates_mbps[i]]
-
-    dispatch.record("tx.encode_many")
-    samples = _jit_encode_many(bb, sb)(
-        jnp.asarray(bits_b), jnp.asarray(nbits_b), jnp.asarray(ridx_b))
-    return TxBatch(samples, n_valid, n_sym, tuple(rates_mbps), sb)
+    prep = batch_host_prep(psdus, rates_mbps, add_fcs)
+    n_valid = (400 + 80 * prep.n_sym).astype(np.int32)
+    with dispatch.timed("tx.encode_many"):
+        samples = _jit_encode_many(prep.bit_bucket, prep.n_sym_bucket)(
+            jnp.asarray(prep.bits_b), jnp.asarray(prep.nbits_b),
+            jnp.asarray(prep.ridx_b))
+    return TxBatch(samples, n_valid, prep.n_sym, tuple(rates_mbps),
+                   prep.n_sym_bucket)
 
 
 def encode_batch(psdus, rate_mbps: int,
@@ -312,9 +354,9 @@ def encode_batch(psdus, rate_mbps: int,
     bits_b = np.zeros((pow2_ceil(n_frames), bb), np.uint8)
     bits_b[:n_frames, :n_bits] = bits
     bits_b[n_frames:] = bits_b[0]
-    dispatch.record("tx.encode_batch")
-    out = _jit_encode_batch(rate_mbps, bb, _sym_bucket(n_sym))(
-        jnp.asarray(bits_b), jnp.int32(n_bits))
+    with dispatch.timed("tx.encode_batch"):
+        out = _jit_encode_batch(rate_mbps, bb, _sym_bucket(n_sym))(
+            jnp.asarray(bits_b), jnp.int32(n_bits))
     return out[:n_frames, :400 + 80 * n_sym]
 
 
@@ -342,9 +384,9 @@ def encode_frame(psdu_bytes, rate_mbps: int,
     bb = _bit_bucket(n_bits)
     bits_pad = np.zeros(bb, np.uint8)
     bits_pad[:n_bits] = bits
-    dispatch.record("tx.encode_frame")
-    out = _jit_encode_frame(rate_mbps, bb, _sym_bucket(n_sym))(
-        jnp.asarray(bits_pad), jnp.int32(n_bits))
+    with dispatch.timed("tx.encode_frame"):
+        out = _jit_encode_frame(rate_mbps, bb, _sym_bucket(n_sym))(
+            jnp.asarray(bits_pad), jnp.int32(n_bits))
     return out[:400 + 80 * n_sym]
 
 

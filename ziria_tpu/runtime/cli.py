@@ -279,6 +279,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="force the per-frame encode/loopback loop "
                         "(the batched TX path's bit-identical "
                         "oracle); also via ZIRIA_BATCHED_TX=0")
+    p.add_argument("--fused-link", dest="fused_link",
+                   action="store_true", default=None,
+                   help="ONE-dispatch fused loopback link "
+                        "(phy/link.loopback_many): the whole "
+                        "TX -> channel -> acquire -> classify -> "
+                        "gather -> mixed decode -> batched-CRC chain "
+                        "as a single jitted device program — the "
+                        "acquisition decision tree traced on-device, "
+                        "1 dispatch per N-frame all-rates multi-SNR "
+                        "batch (the default; docs/architecture.md). "
+                        "Also via ZIRIA_FUSED_LINK=1")
+    p.add_argument("--no-fused-link", dest="fused_link",
+                   action="store_false",
+                   help="force the staged ~5-dispatch loopback "
+                        "(encode_many + impair_many + acquire/gather/"
+                        "decode — the fused graph's bit-identical "
+                        "oracle); also via ZIRIA_FUSED_LINK=0")
     return p
 
 
@@ -630,6 +647,11 @@ def main(argv=None) -> int:
         # twin of the batched-acquire knob)
         overrides["ZIRIA_BATCHED_TX"] = \
             "1" if args.batched_tx else "0"
+    if args.fused_link is not None:
+        # link.fused_link_enabled reads this at call time (the
+        # one-dispatch loopback vs its staged 5-dispatch oracle)
+        overrides["ZIRIA_FUSED_LINK"] = \
+            "1" if args.fused_link else "0"
     if not overrides:
         return _main_run(args)
     prev = {k: os.environ.get(k) for k in overrides}

@@ -1,0 +1,22 @@
+"""Version-compat shims for the jax surface this image ships.
+
+One copy, imported by every consumer — the alternative (per-module
+try/except blocks) already drifted once: only one of the two copies
+mapped the renamed replication-check kwarg, so the other would have
+raised on the older jax the moment it started passing it.
+"""
+
+from __future__ import annotations
+
+try:                      # newer jax exposes it at top level
+    from jax import shard_map
+except ImportError:       # this image's jax: experimental namespace,
+    # where the replication-check kwarg is still called check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *args, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, *args, **kw)
+
+__all__ = ["shard_map"]

@@ -15,7 +15,13 @@ both a first-class seam:
   stable public hook for "a compiled program ran", so we count where
   WE launch device work; eager jnp call sites count as one dispatch
   however many primitives they fan into, making every reported bound
-  a LOWER bound on real device calls).
+  a LOWER bound on real device calls). Sites wrapped with
+  :func:`timed` additionally accumulate per-site *wall time*
+  (``DispatchCount.times``, seconds): the host-side time spent in the
+  instrumented call — dispatch plus any blocking the call does. On a
+  synchronous backend (CPU) that is the stage's real wall time; on an
+  async one it is a lower bound (the dispatch tax itself), which is
+  exactly the number the host-link analyses need.
 - :func:`cache_growth` — lru-delta measurement for the jit-factory
   caches (``rx._jit_decode_data_mixed`` etc.): the compile-count
   proxy `tests/test_rx_mixed_dispatch.py` used to hand-roll. Deltas,
@@ -38,9 +44,10 @@ and the TX batch path).
 from __future__ import annotations
 
 import threading
+import time
 from collections import Counter
 from contextlib import contextmanager
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 _LOCK = threading.Lock()
 _ACTIVE: List["DispatchCount"] = []
@@ -74,14 +81,27 @@ def pad_lanes(lanes: Sequence) -> list:
 
 class DispatchCount:
     """Labelled dispatch tally filled in by :func:`record` while its
-    :func:`count_dispatches` block is active."""
+    :func:`count_dispatches` block is active. ``counts`` holds the
+    per-site dispatch counts; ``times`` the per-site accumulated wall
+    seconds from :func:`timed` sites (sites instrumented with bare
+    :func:`record` contribute counts only)."""
 
     def __init__(self) -> None:
         self.counts: Counter = Counter()
+        self.times: Counter = Counter()      # label -> wall seconds
 
     @property
     def total(self) -> int:
         return sum(self.counts.values())
+
+    @property
+    def total_time(self) -> float:
+        return float(sum(self.times.values()))
+
+    def times_ms(self) -> Dict[str, float]:
+        """Per-site wall time in milliseconds, rounded for reports."""
+        return {k: round(v * 1e3, 3) for k, v in sorted(
+            self.times.items())}
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         inner = ", ".join(f"{k}={v}" for k, v in sorted(
@@ -89,8 +109,11 @@ class DispatchCount:
         return f"DispatchCount(total={self.total}, {inner})"
 
 
-def record(label: str = "dispatch", n: int = 1) -> None:
-    """Report ``n`` device dispatches at an instrumented call site.
+def record(label: str = "dispatch", n: int = 1,
+           seconds: Optional[float] = None) -> None:
+    """Report ``n`` device dispatches at an instrumented call site,
+    optionally with the wall time the call took (``seconds``; the
+    :func:`timed` wrapper measures and passes it).
 
     Free when no counter is active (one lock-free len check), so the
     hot paths carry their instrumentation permanently.
@@ -100,6 +123,26 @@ def record(label: str = "dispatch", n: int = 1) -> None:
     with _LOCK:
         for c in _ACTIVE:
             c.counts[label] += n
+            if seconds is not None:
+                c.times[label] += seconds
+
+
+@contextmanager
+def timed(label: str = "dispatch"):
+    """``with timed("rx.sync"): ...`` — record ONE dispatch at the
+    site plus the wall time of the block. The preferred form for
+    instrumented call sites: dispatch *time*, not just count, becomes
+    observable per stage (`tools/rx_dispatch_bench.py` stats blocks
+    report both). Near-free when no counter is active (one clock pair
+    and a len check)."""
+    if not _ACTIVE:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record(label, seconds=time.perf_counter() - t0)
 
 
 @contextmanager
