@@ -1176,6 +1176,35 @@ def _child_main(run_id):
             note(f"ber sweep stage failed: {e!r}")
             sweep_ev = {"error": repr(e)}
 
+    # ISSUE 5 tentpole evidence: the streaming receiver's O(chunks)
+    # dispatch count vs the per-capture path's O(frames) over the same
+    # multi-frame stream, identity-gated, with the double-buffer
+    # in-flight gauge. Same resumable never-fatal stage discipline.
+    def _streaming_rx_stage():
+        if time.time() - t0 > 0.97 * budget:
+            raise TimeoutError("skipped: child time budget")
+        cpu = os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+        ev = _load_rx_dispatch_bench().streaming_stats(
+            n_frames=8 if cpu else 16)
+        note(f"streaming rx: {ev['frames']} frames / "
+             f"{ev['chunks']} chunks, "
+             f"{ev['dispatches_percapture']} dispatches -> "
+             f"{ev['dispatches_streaming']} "
+             f"({ev['sps_streaming']:.0f} sps, in-flight "
+             f"{ev['max_in_flight']})")
+        part("streaming_rx", **ev)
+        return ev
+
+    if "streaming_rx" in resume:
+        stream_ev = reuse(resume["streaming_rx"])
+        note("streaming rx resumed from prior window")
+    else:
+        try:
+            stream_ev = _streaming_rx_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"streaming rx stage failed: {e!r}")
+            stream_ev = {"error": repr(e)}
+
     def _percall_fence_stage():
         # per-call diagnostic (tunnel-dispatch-bound upper bound on
         # latency) — always taken at the base batch of 128, which may
@@ -1245,6 +1274,7 @@ def _child_main(run_id):
         "link_loopback": link_ev,
         "fused_link": fused_ev,
         "ber_sweep": sweep_ev,
+        "streaming_rx": stream_ev,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
         "resumed_stages": sorted(set(resumed_stages)),
     }
@@ -1283,8 +1313,24 @@ def _run_one_child(argv, tmo: int):
         return None, "", ""
 
 
+_PROBE_NEG = None     # this-invocation memo of a definitive probe failure
+
+
 def _probe(deadline):
-    """Health-check the backend cheaply. Returns (ok, err)."""
+    """Health-check the backend cheaply. Returns (ok, err).
+
+    A NEGATIVE result is cached for the rest of this invocation
+    (module-level memo) and a probe *timeout* is treated as
+    definitive immediately: a hang means the axon tunnel is down, not
+    a transient child flake, and BENCH_r05 measured the same 90 s
+    hang re-paid 2-3x per run (~200 s of a ~540 s deadline burned on
+    repeats of a known answer). Transient non-zero exits still retry
+    up to PROBE_TRIES; only the retry-proof failure modes memoize.
+    """
+    global _PROBE_NEG
+    if _PROBE_NEG is not None:
+        return False, f"{_PROBE_NEG} (cached: probed once this " \
+                      f"invocation, not re-paying the probe)"
     err = None
     for i in range(PROBE_TRIES):
         if time.time() + PROBE_TIMEOUT + 30 > deadline:
@@ -1294,12 +1340,16 @@ def _probe(deadline):
         rc, out, errtxt = _run_one_child(["--tpu-probe"], PROBE_TIMEOUT)
         if rc is None:
             err = f"probe {i + 1}: timeout after {PROBE_TIMEOUT}s (hang)"
+            print(f"[bench] {err}", file=sys.stderr, flush=True)
+            _PROBE_NEG = err
+            return False, err
         elif rc == 0:
             return True, None
         else:
             tail = (errtxt or "").strip().splitlines()[-2:]
             err = f"probe {i + 1}: rc={rc}: " + " | ".join(tail)
         print(f"[bench] {err}", file=sys.stderr, flush=True)
+    _PROBE_NEG = err
     return False, err
 
 

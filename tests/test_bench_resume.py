@@ -183,3 +183,43 @@ def test_pinned_baseline_reader(tmp_path, monkeypatch):
                              "pinned_at": "2026-07-31"}}))
     pin = bench._pinned_baseline()
     assert pin["sps"] == 6401460.9
+
+
+def test_probe_hang_cached_within_invocation(monkeypatch):
+    """A probe TIMEOUT is definitive for the invocation (the tunnel is
+    down, not flaking): no same-call retry, and a second _probe call
+    reuses the cached negative — BENCH_r05 paid the same 90 s hang
+    2-3x per run (~200 s wall) before this memo."""
+    b = _bench()                       # fresh module: isolated memo
+    calls = []
+
+    def fake_child(argv, tmo):
+        calls.append(argv)
+        return None, "", ""            # rc None == timeout/hang
+
+    monkeypatch.setattr(b, "_run_one_child", fake_child)
+    deadline = __import__("time").time() + 10_000
+    ok, err = b._probe(deadline)
+    assert not ok and "timeout" in err
+    assert len(calls) == 1             # a hang is not retried
+    ok2, err2 = b._probe(deadline)
+    assert not ok2 and "cached" in err2
+    assert len(calls) == 1             # ...and never re-paid
+
+
+def test_probe_transient_rc_still_retries(monkeypatch):
+    """A non-zero exit stays a transient: the retry loop (which fixed
+    BENCH_r01) is untouched, and a retry that SUCCEEDS leaves no
+    negative memo behind."""
+    b = _bench()
+    calls = []
+
+    def fake_child(argv, tmo):
+        calls.append(argv)
+        return (1, "", "boom") if len(calls) == 1 else (0, "{}", "")
+
+    monkeypatch.setattr(b, "_run_one_child", fake_child)
+    monkeypatch.setattr(b, "PROBE_BACKOFF", 0)
+    ok, _err = b._probe(__import__("time").time() + 10_000)
+    assert ok and len(calls) == 2
+    assert b._PROBE_NEG is None

@@ -53,6 +53,14 @@ smoke or a manual chip window:
   per point), error counts gated integer-identical, sweep points/s
   and samples/s recorded.
 
+- ``streaming_stats`` (ISSUE 5 tentpole): a long multi-frame I/Q
+  stream (``link.stream_many``: all 8 rates, random gaps, CFO, delay,
+  AWGN) through ``framebatch.receive_stream`` — <= 2 dispatches per
+  CHUNK (O(chunks), frame count free) vs >= 3 per FRAME for the
+  per-capture path over the same detected windows — identity-gated
+  frame for frame (results AND starts vs ground truth), samples/s,
+  dispatch counts, and the double-buffer in-flight depth gauge.
+
 Standalone: ``ZIRIA_TOOL_ALLOW_CPU=1 python tools/rx_dispatch_bench.py``
 runs all at shrunk sizes on CPU (results labelled platform=cpu,
 never mistakable for chip evidence). Emits ONE JSON object.
@@ -431,6 +439,79 @@ def ber_sweep_stats(n_frames=16, n_bytes=50, rates=(6, 24, 54),
     }
 
 
+def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
+                    chunk_len=4096, frame_len=1024, k=8):
+    """An N-frame continuous stream through the chunked streaming
+    receiver vs the per-capture oracle over the same detected windows:
+    dispatch counts (instrumented counter — the O(chunks) vs O(frames)
+    collapse), wall times, samples/s, the in-flight depth gauge, and
+    a frame-for-frame identity gate (every emitted start must hit the
+    synthesizer's ground truth; every RxResult must be bit-identical
+    to the oracle's). ``check_fcs=True`` so the masked-CRC tail rides
+    the measurement. Returns a flat dict."""
+    from ziria_tpu.backend import framebatch
+    from ziria_tpu.phy import link
+    from ziria_tpu.phy.wifi.params import RATES
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    rng = np.random.default_rng(17)
+    mbps = (sorted(RATES) * (-(-n_frames // len(RATES))))[:n_frames]
+    psdus = [rng.integers(0, 256, n_bytes).astype(np.uint8)
+             for _ in range(n_frames)]
+    stream, starts = link.stream_many(
+        psdus, mbps, snr_db=snr_db, cfo=1e-4, delay=60, seed=8,
+        add_fcs=True, tail=frame_len)
+    kw = dict(chunk_len=chunk_len, frame_len=frame_len,
+              max_frames_per_chunk=k, check_fcs=True)
+
+    with count_dispatches() as d_pc:
+        res_p, st_p = framebatch.receive_stream(stream, streaming=False,
+                                                **kw)
+    t_pc = _timed(lambda: framebatch.receive_stream(
+        stream, streaming=False, **kw))
+
+    with count_dispatches() as d_st:
+        res_s, st_s = framebatch.receive_stream(stream, streaming=True,
+                                                **kw)
+    t_st = _timed(lambda: framebatch.receive_stream(
+        stream, streaming=True, **kw))
+
+    assert [f.start for f in res_s] == list(starts), \
+        "streaming starts diverged from the synthesizer ground truth"
+    # identity first (field for field, failures included), THEN the
+    # all-decoded gate — a lane failing identically in both paths is
+    # not a divergence and must not be reported as one
+    assert len(res_p) == len(res_s) and all(
+        a.start == b.start and a.result.ok == b.result.ok
+        and a.result.crc_ok == b.result.crc_ok
+        and a.result.rate_mbps == b.result.rate_mbps
+        and a.result.length_bytes == b.result.length_bytes
+        and np.array_equal(a.result.psdu_bits, b.result.psdu_bits)
+        for a, b in zip(res_p, res_s)), \
+        "streaming receive diverged from the per-capture path"
+    assert all(f.result.ok and f.result.crc_ok for f in res_s), \
+        "a stimulus frame failed to decode (identically in both paths)"
+
+    n_samples = stream.shape[0]
+    return {
+        "frames": n_frames, "frame_bytes": n_bytes, "snr_db": snr_db,
+        "stream_samples": n_samples, "chunks": st_s.chunks,
+        "chunk_len": chunk_len, "frame_len": frame_len,
+        "dispatches_percapture": d_pc.total,
+        "dispatches_streaming": d_st.total,
+        "dispatch_breakdown_streaming": dict(d_st.counts),
+        "dispatch_times_ms_streaming": d_st.times_ms(),
+        "dispatch_times_ms_percapture": d_pc.times_ms(),
+        "max_in_flight": st_s.max_in_flight,
+        "overflow_chunks": st_s.overflow_chunks,
+        "t_percapture_s": round(t_pc, 4),
+        "t_streaming_s": round(t_st, 4),
+        "sps_percapture": round(n_samples / t_pc, 1),
+        "sps_streaming": round(n_samples / t_st, 1),
+        "bit_identical": True,
+    }
+
+
 def main():
     import jax
 
@@ -452,6 +533,7 @@ def main():
         out["fused_link"] = fused_link_stats(n_bytes=24)
         out["ber_sweep"] = ber_sweep_stats(
             n_frames=8, n_bytes=24, rates=(6, 54), snrs=(3.0, 8.0))
+        out["streaming_rx"] = streaming_stats(n_frames=8)
     else:
         out["quantized"] = quantized_sweep()
         out["mixed_dispatch"] = mixed_dispatch_stats()
@@ -461,6 +543,7 @@ def main():
         out["link_loopback"] = link_loopback_stats()
         out["fused_link"] = fused_link_stats()
         out["ber_sweep"] = ber_sweep_stats()
+        out["streaming_rx"] = streaming_stats()
     print(json.dumps(out))
     return 0
 

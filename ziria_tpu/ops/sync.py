@@ -71,6 +71,15 @@ def detect_packet(samples, window: int = 48, threshold: float = 0.75,
     Data-dependent only in the returned index, so it jits (lax-friendly
     argmax over a boolean ramp).
 
+    This is the K=1, first-crossing special case of the multi-peak
+    :func:`locate_frames` scan: one threshold crossing, no plateau
+    `min_run` gate, no dead-zone suppression — exactly what a
+    pre-segmented one-frame capture needs, and the detection gate the
+    per-capture oracle (:func:`locate_frame`) keeps. The streaming
+    receiver's chunk scan generalizes it to "every plateau in a long
+    chunk"; this single-crossing form stays the oracle the K=1 lane of
+    that scan is judged against.
+
     ``limit`` (static or traced) caps the considered positions to
     those a LIMIT-length capture would evaluate — see
     :func:`locate_frame`, the one caller that needs it. This is THE
@@ -114,6 +123,37 @@ def correct_cfo(samples, eps):
     return cplx.cmul(x, rot)
 
 
+def lts_pair_metric(samples, limit=None):
+    """The LTS timing metric shared by the single-frame and streaming
+    locators: cross-correlate the stream against the known long
+    training symbol and sum the two 64-apart peak candidates, so
+    ``pair[k]`` is large exactly when the first LTS starts at ``k``
+    (frame start = k - 192). samples: (n, 2). Returns (n - 127,) f32,
+    all values >= 0 except ``limit``-masked tail positions, which are
+    -1 sentinels (a LIMIT-length capture would never evaluate them;
+    they can never win an argmax while any in-cap position exists).
+
+    Each value depends only on its 128-sample local window — the
+    position-locality that lets the chunked streaming scan and the
+    per-capture path read bit-identical values off differently-sized
+    arrays covering the same samples."""
+    x = jnp.asarray(samples, jnp.float32)
+    n = x.shape[0]
+    lim = n if limit is None else limit
+    lts = jnp.asarray(lts_time_symbol())                # (64, 2)
+    ref = cplx.conj(lts)[::-1]                          # reversed conj
+
+    def conv1(u, v):
+        return jnp.convolve(u, v, precision="highest")
+
+    re = conv1(x[:, 0], ref[:, 0]) - conv1(x[:, 1], ref[:, 1])
+    im = conv1(x[:, 0], ref[:, 1]) + conv1(x[:, 1], ref[:, 0])
+    # full conv index 63+k = correlation at lag k
+    c = re[63:n] ** 2 + im[63:n] ** 2                   # (n-63,)
+    pair = c[:-64] + c[64:]                             # two-peak sum
+    return jnp.where(jnp.arange(pair.shape[0]) < lim - 127, pair, -1.0)
+
+
 def locate_frame(samples, limit=None, window: int = 48,
                  threshold: float = 0.75):
     """Locate and align a frame in a sample stream: STS detection
@@ -148,26 +188,10 @@ def locate_frame(samples, limit=None, window: int = 48,
     detected, _coarse = detect_packet(x, window, threshold, limit=limit)
 
     # LTS timing: cross-correlate with the known long symbol; the two
-    # LTS peaks are 64 apart; first LTS starts at frame_start + 192
-    lts = jnp.asarray(lts_time_symbol())                # (64, 2)
-
-    def xcorr(sig):
-        # correlation of sig against lts at all lags (valid region)
-        ref = cplx.conj(lts)[::-1]                      # reversed conj
-
-        def conv1(u, v):
-            return jnp.convolve(u, v, precision="highest")
-
-        re = conv1(sig[:, 0], ref[:, 0]) - conv1(sig[:, 1], ref[:, 1])
-        im = conv1(sig[:, 0], ref[:, 1]) + conv1(sig[:, 1], ref[:, 0])
-        # full conv index 63+k = correlation at lag k
-        return (re[63:n] ** 2 + im[63:n] ** 2)
-
-    c = xcorr(x)                                        # (n-63,)
-    pair = c[:-64] + c[64:]                             # two-peak sum
-    # cap the peak-pick the same way (pair values are >= 0, so -1
-    # sentinels can never win argmax while any in-cap position exists)
-    pair = jnp.where(jnp.arange(pair.shape[0]) < lim - 127, pair, -1.0)
+    # LTS peaks are 64 apart; first LTS starts at frame_start + 192.
+    # The peak-pick is capped the same way as the detect gate (the
+    # shared metric masks out-of-cap positions to -1 sentinels).
+    pair = lts_pair_metric(x, limit=lim)
     lts1 = jnp.argmax(pair).astype(jnp.int32)
     frame_start = jnp.maximum(lts1 - 192, 0)
 
@@ -179,6 +203,110 @@ def locate_frame(samples, limit=None, window: int = 48,
     head2 = correct_cfo(frame_head, eps_c)
     eps_f = estimate_cfo_lts(head2)
     return detected, frame_start, eps_c + eps_f
+
+
+# ----------------------------------------------------- streaming detection
+#
+# The chunked streaming receiver (backend/framebatch.receive_stream)
+# needs the detection front end as "every frame in a LONG multi-frame
+# chunk", not "the first frame of a pre-segmented capture".
+# `locate_frames` is that generalization, fully traced so a chunk's
+# whole scan rides one dispatch; `locate_frame` above stays the K=1
+# first-peak oracle (single crossing, global peak-pick) that the
+# per-capture receive path — and the identity contract of every
+# streaming test — is judged against.
+
+
+def locate_frames(samples, k: int, limit=None, window: int = 48,
+                  threshold: float = 0.75, min_run: int = 33,
+                  dead_zone: int = 320, align_back: int = 32,
+                  align_span: int = 416, overflow_limit=None):
+    """Locate up to ``k`` frame starts in a multi-frame sample chunk:
+    top-K STS plateau extraction with dead-zone suppression, each
+    candidate LTS-aligned by a local peak-pick. Returns
+    ``(found (k,), starts (k,), overflow ())`` — `starts` are exact
+    frame-start indices (ascending; -1 on not-found lanes), `overflow`
+    is True when an eligible plateau remains beyond the K extracted
+    (the caller must report it — frames are never silently dropped).
+
+    The scan (all whole-array ops at fixed shapes, `k` static — jits
+    and vmaps):
+
+    1. **plateau gate**: a candidate needs ``min_run`` consecutive
+       above-``threshold`` autocorrelation positions — the traced twin
+       of `phy/search.find_packets`' host plateau rule (the energy
+       roll-off at a frame's END can spike the normalized metric for a
+       few positions; a real STS plateau spans ~96).
+    2. **top-K extraction**: iteratively take the FIRST eligible
+       plateau start, then suppress positions within ``dead_zone``
+       samples of it. The dead zone must exceed the plateau run
+       (~96 + noise slack, so one frame never yields two candidates)
+       and stay under the minimum frame spacing (480 samples, a
+       1-symbol frame at zero gap) minus the partial-preamble overhang
+       a chunk boundary can introduce — 320, the preamble length,
+       satisfies both.
+    3. **local LTS alignment**: the shared :func:`lts_pair_metric` is
+       computed ONCE over the chunk; each candidate's start is the
+       two-peak argmax within ``[d - align_back, d - align_back +
+       align_span)`` of its crossing ``d`` minus the 192-sample
+       preamble offset. The restriction to a local window is what
+       keeps K frames from stealing each other's peaks — and with one
+       frame in the chunk it picks the same global peak
+       :func:`locate_frame` does (the K=1 oracle relationship;
+       :func:`detect_packet` is the matching single-crossing gate).
+
+    ``limit`` (static or traced) caps both the plateau gate and the
+    peak-pick to positions a LIMIT-length capture would evaluate,
+    exactly as in :func:`locate_frame` — chunk zero-padding (a final
+    partial chunk) never manufactures or perturbs candidates.
+
+    ``overflow_limit`` (static or traced, default: everything) caps
+    the positions the OVERFLOW scan considers: a streaming chunk owns
+    only its first `stride` samples, and a leftover plateau in the
+    deferred overlap region is the NEXT chunk's frame, not a drop —
+    without the cap it would flag healthy streams. The cap uses the
+    plateau crossing index (within ~an alignment span of the exact
+    start), which is exact enough for a widen-K diagnostic."""
+    import jax
+
+    x = jnp.asarray(samples, jnp.float32)
+    n = x.shape[0]
+    lim = n if limit is None else limit
+
+    metric, _ = sts_autocorr(x, window)
+    above = metric > threshold
+    above = above & (jnp.arange(above.shape[0]) < lim - 16 - window + 1)
+    # ok[p] <=> positions [p, p+min_run) all above: integer sliding sum
+    # (exact cumsum-difference path of _sliding_sum)
+    runs = _sliding_sum(above.astype(jnp.int32), min_run)
+    ok = runs == min_run
+    idx = jnp.arange(ok.shape[0])
+
+    def body(next_free, _):
+        cand = ok & (idx >= next_free)
+        found = jnp.any(cand)
+        d = jnp.argmax(cand).astype(jnp.int32)   # first eligible start
+        return jnp.where(found, d + dead_zone, next_free), (found, d)
+
+    next_free, (found, d) = jax.lax.scan(
+        body, jnp.int32(0), None, length=k)
+    rem = ok & (idx >= next_free)
+    if overflow_limit is not None:
+        rem = rem & (idx < overflow_limit)
+    overflow = jnp.any(rem)
+
+    pair = lts_pair_metric(x, limit=lim)
+    pidx = jnp.arange(pair.shape[0])
+
+    def align(di):
+        lo = di - align_back
+        local = jnp.where((pidx >= lo) & (pidx < lo + align_span),
+                          pair, -1.0)
+        return jnp.argmax(local).astype(jnp.int32) - 192
+
+    starts = jax.vmap(align)(d)
+    starts = jnp.where(found, starts, jnp.int32(-1))
+    return found, starts, overflow
 
 
 def estimate_channel(samples):

@@ -175,6 +175,36 @@ def impair_one(samples, snr_db, eps, delay, seed, lane: int,
             jnp.float32(eps), jnp.int32(delay), lane_key(seed, lane))
 
 
+def impair_stream(stream, n_signal: int, snr_db, eps, seed) -> np.ndarray:
+    """Whole-stream impairments for the streaming-receiver stimulus
+    (`phy/link.stream_many`): one CFO rotation over the FULL stream
+    (a single oscillator offset — every frame sees the same eps, at
+    its own carrier phase) and AWGN at `snr_db` relative to the
+    average *frame* power. `n_signal` is the count of real signal
+    samples in the stream — the inter-frame gaps are idle air and
+    must not deflate the reference power the way a whole-stream mean
+    would. ``np.inf`` disables noise exactly. Host numpy (float64
+    trig, f32 samples): this is deterministic test/bench stimulus,
+    not a serving path — the receiver under test never sees these
+    intermediates, only the returned f32 stream."""
+    x = np.asarray(stream, np.float32)
+    if eps:
+        n = np.arange(x.shape[0], dtype=np.float64)
+        c = np.cos(float(eps) * n)
+        s = np.sin(float(eps) * n)
+        x = np.stack([x[:, 0] * c - x[:, 1] * s,
+                      x[:, 0] * s + x[:, 1] * c], axis=-1)
+        x = x.astype(np.float32)
+    if np.isfinite(snr_db):
+        p_sig = float(np.sum(x.astype(np.float64) ** 2)
+                      / max(int(n_signal), 1))
+        p_noise = p_sig / (10.0 ** (float(snr_db) / 10.0))
+        rng = np.random.default_rng(seed)
+        noise = rng.normal(scale=np.sqrt(p_noise / 2.0), size=x.shape)
+        x = (x + noise).astype(np.float32)
+    return x
+
+
 def multipath(samples, taps_pair) -> jnp.ndarray:
     """Complex FIR channel: taps_pair (L, 2). Causal, same length out."""
     x = jnp.asarray(samples, jnp.float32)

@@ -338,6 +338,71 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
     return results
 
 
+def stream_many(psdus, rates_mbps: Sequence[int], gaps=None,
+                snr_db=np.inf, cfo: float = 0.0, delay: int = 0,
+                seed: int = 0, add_fcs: bool = False,
+                tail: int = 2048,
+                batched_tx: Optional[bool] = None):
+    """Synthesize a continuous multi-frame I/Q stream — the stimulus
+    of the streaming receiver (`framebatch.receive_stream`) and its
+    bench: N mixed-rate frames at random (or given) inter-frame gaps,
+    an initial `delay` of idle air, whole-stream CFO, and AWGN over
+    everything (`channel.impair_stream` — SNR referenced to frame
+    power, so gap length never changes the noise level). Frames ride
+    the ONE-dispatch batched TX (`transmit_many`; per-frame oracle
+    under ``batched_tx=False``, bit-identical).
+
+    Returns ``(stream, starts)``: the (n, 2) f32 stream and the TRUE
+    frame-start indices — the ground truth the streaming identity
+    contract slices at. `gaps` is a length-(N-1) sequence of samples
+    between a frame's end and the next frame's start; default: seeded
+    random in [300, 600) — wide enough that a `frame_len`-tight
+    receive window over one frame never also spans the NEXT frame's
+    long preamble (per-capture `receive`'s global LTS peak-pick could
+    otherwise time onto the stronger neighbor; identity would hold,
+    per-frame decode would not). `tail` idle samples close the stream
+    so the last frame's window is full-length."""
+    n = len(psdus)
+    if len(rates_mbps) != n:
+        raise ValueError(f"{n} PSDUs but {len(rates_mbps)} rates")
+    if n == 0:
+        if np.isfinite(snr_db):
+            # SNR is referenced to frame power; with no frames there
+            # is nothing to reference, and silently returning zeros
+            # would masquerade as a noise stimulus
+            raise ValueError("stream_many with zero frames has no "
+                             "frame power to reference snr_db against;"
+                             " synthesize noise directly")
+        return (np.zeros((int(tail), 2), np.float32),
+                np.zeros((0,), np.int64))
+    frames = transmit_many(psdus, rates_mbps, add_fcs=add_fcs,
+                           batched_tx=batched_tx)
+    rng = np.random.default_rng(seed)
+    if gaps is None:
+        gaps = rng.integers(300, 600, size=max(n - 1, 0))
+    gaps = np.asarray(gaps, np.int64)
+    if gaps.shape[0] != n - 1:
+        raise ValueError(f"{n} frames need {n - 1} gaps, "
+                         f"got {gaps.shape[0]}")
+    if n > 1 and (gaps < 0).any():
+        raise ValueError("negative gap")
+    if int(delay) < 0:
+        raise ValueError("negative delay")
+
+    starts = np.zeros(n, np.int64)
+    pos = int(delay)
+    for i, f in enumerate(frames):
+        starts[i] = pos
+        pos += f.shape[0] + (int(gaps[i]) if i < n - 1 else 0)
+    stream = np.zeros((pos + int(tail), 2), np.float32)
+    n_signal = 0
+    for s, f in zip(starts, frames):
+        stream[s: s + f.shape[0]] = f
+        n_signal += f.shape[0]
+    return (channel.impair_stream(stream, n_signal, snr_db, cfo, seed),
+            starts)
+
+
 def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
                       batched_tx: Optional[bool] = None) -> np.ndarray:
     """Perfect-sync single-rate BER loopback — the statistical lane of

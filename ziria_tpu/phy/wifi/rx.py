@@ -154,13 +154,19 @@ def decode_data_batch(frames, rate: RateParams, n_sym: int,
 
 
 def sync_frame(samples):
-    """Locate and align a frame in a sample stream: STS detection gate,
-    LTS cross-correlation timing, coarse+fine CFO. Returns
-    (found, frame_start_index, cfo_estimate). Fixed shapes -> jits.
+    """Locate and align ONE frame in a pre-segmented capture: STS
+    detection gate, LTS cross-correlation timing, coarse+fine CFO.
+    Returns (found, frame_start_index, cfo_estimate). Fixed shapes ->
+    jits.
 
     The graph itself lives in ``ops/sync.locate_frame`` (vmap-ready so
     ``acquire_many`` can batch it); this name is the receiver-side
-    oracle entry the per-capture path and tests use."""
+    oracle entry the per-capture path and tests use. It is the K=1
+    special case of the streaming front end — first crossing, global
+    peak-pick — that ``ops/sync.locate_frames``' multi-peak chunk scan
+    (the ``receive_stream`` detector) generalizes and is judged
+    against; a one-frame capture gives identical (found, start) either
+    way."""
     return sync.locate_frame(samples)
 
 
@@ -702,6 +708,133 @@ def _padded_segment(acq: _Acquired, n_sym_bucket: int):
     frame_pad[:n] = acq.frame_np[:n]
     with dispatch.timed("rx.cfo_segment"):
         return sync.correct_cfo(jnp.asarray(frame_pad), acq.eps)
+
+
+# ------------------------------------------------------ streaming receiver
+#
+# The per-chunk device half of `backend/framebatch.receive_stream`:
+# ONE jitted graph turns a long multi-frame chunk into K dense
+# candidate lanes — multi-peak detect (`ops/sync.locate_frames`),
+# per-candidate window extraction at the traced aligned starts, the
+# vmapped per-window acquisition (`acquire_frame_graph`, the SAME
+# graph the batched per-capture path runs, so every window decodes
+# bit-identically to `receive` over that window), and the
+# gather+derotate at ONE fixed symbol bucket. A second fixed-geometry
+# jit decodes the chunk's decodable lanes (mixed-rate switch + masked
+# CRC). Between the two sits only the integer `_classify_acquire`
+# tree — the blind receive's genuinely data-dependent step.
+
+
+def _stream_bucket_graph(n_valid, cap: int):
+    """Traced twin of `_stream_bucket` (power-of-two capture bucket,
+    floor 512) for per-lane true sample counts up to the static window
+    length `cap` — the streaming windows share one common buffer, so
+    each lane's detector cap must be ITS OWN bucket for bit-identity
+    with per-capture `receive` (the `acquire_many` limit rule). The
+    unrolled compare ladder is exact where float log2 would not be;
+    `tests/test_rx_stream.py` pins it against the host rule."""
+    b = jnp.full(jnp.shape(n_valid), 512, jnp.int32)
+    m = 512
+    while m < cap:
+        m *= 2
+        b = jnp.where(jnp.asarray(n_valid) > m // 2, m, b)
+    return b
+
+
+def stream_chunk_graph(chunk, chunk_valid, own_lo, own_hi, k: int,
+                       win_len: int, n_sym_bucket: int,
+                       threshold: float = 0.75, min_run: int = 33,
+                       dead_zone: int = 320):
+    """One streaming chunk, fully traced (dispatch 1 of 2 per chunk):
+
+    1. `sync.locate_frames`: up to `k` exact frame starts (plateau
+       gate, dead-zone suppression, local LTS alignment) over the
+       chunk's `chunk_valid` real samples.
+    2. ownership mask: only starts in ``[own_lo, own_hi)`` are this
+       chunk's (`own_hi` = the chunk stride, or the valid length on
+       the final chunk; `own_lo` = 0 except on the STREAM's first
+       chunk, where -192 admits a head-truncated preamble whose LTS
+       peak-pick lands below the 192-sample offset — per-capture
+       `locate_frame` clamps such a start to 0 and still reports,
+       and so must we; on later chunks a negative start is a frame
+       owned by the PREVIOUS chunk). Boundary-straddling frames
+       re-detect fully inside the NEXT chunk's overlap and are owned
+       exactly once.
+    3. per-candidate `win_len`-sample window extraction at the traced
+       starts, clamped to 0 exactly as `locate_frame` clamps
+       (`dynamic_slice` — the window IS the capture the per-capture
+       oracle would see for `stream[max(start,0) : +win_len]`).
+    4. the vmapped per-window acquisition (detect gate, LTS timing,
+       CFO, SIGNAL decode) with per-lane true counts and own-bucket
+       detector caps, and
+    5. gather+derotate of every window's data region at the ONE static
+       symbol bucket (garbage on failed lanes, masked host-side).
+
+    Returns ``(own, starts, overflow, found, fstart, eps, rate_bits,
+    length, parity_ok, n_valid, segs)`` — everything before `segs` is
+    K scalars per lane (one host transfer; `starts` already clamped),
+    `segs` stays device-resident for the decode dispatch."""
+    # overflow scan cap: the scan sees plateau CROSSING indices, and a
+    # frame aligned at start s can cross as late as s + 224 (the
+    # alignment window spans [d-32, d+384) and start = peak - 192, so
+    # s >= d - 224). Capping at own_hi + 224 therefore counts every
+    # surplus frame THIS chunk owns (never a silent drop), at the cost
+    # of flagging deferred frames in a 224-sample sliver past the
+    # bound — the conservative side for a widen-K diagnostic.
+    found, starts, overflow = sync.locate_frames(
+        chunk, k, limit=chunk_valid, threshold=threshold,
+        min_run=min_run, dead_zone=dead_zone,
+        overflow_limit=own_hi + 224)
+    own = found & (starts >= own_lo) & (starts < own_hi)
+    starts = jnp.where(own, jnp.maximum(starts, 0), starts)
+    # tail-pad before slicing: a final-chunk start may sit within
+    # win_len of the chunk end (the stream genuinely ends there, so
+    # the window's zero tail is exactly the oracle slice's bucket
+    # pad); clamping the slice instead would silently shift the lane
+    safe = jnp.clip(starts, 0, chunk.shape[0])
+    chunk_pad = jnp.pad(chunk, ((0, win_len), (0, 0)))
+    wins = jax.vmap(lambda s: jax.lax.dynamic_slice(
+        chunk_pad, (s, jnp.int32(0)), (win_len, 2)))(safe)
+    nv = jnp.clip(jnp.asarray(chunk_valid, jnp.int32) - safe,
+                  0, win_len).astype(jnp.int32)
+    lim = _stream_bucket_graph(nv, win_len)
+    f2, fstart, eps, rb, ln, pk = jax.vmap(acquire_frame_graph)(
+        wins, nv, lim)
+    need_b = FRAME_DATA_START + 80 * n_sym_bucket
+    wins_pad = jnp.pad(wins, ((0, 0), (0, need_b), (0, 0)))
+    segs = jax.vmap(lambda xi, s, e, a: gather_segment_graph(
+        xi, s, e, a, n_sym_bucket))(wins_pad, fstart, eps, nv - fstart)
+    return own, starts, overflow, f2, fstart, eps, rb, ln, pk, nv, segs
+
+
+@lru_cache(maxsize=None)
+def _jit_stream_chunk(k: int, win_len: int, n_sym_bucket: int,
+                      threshold: float = 0.75, min_run: int = 33,
+                      dead_zone: int = 320):
+    """ONE compiled chunk scan per (K, window, symbol bucket, detector
+    params) — chunk length retraces per shape; a stream of uniform
+    chunks compiles ONCE and every chunk is a re-dispatch."""
+    def f(chunk, chunk_valid, own_lo, own_hi):
+        return stream_chunk_graph(chunk, chunk_valid, own_lo, own_hi,
+                                  k, win_len, n_sym_bucket, threshold,
+                                  min_run, dead_zone)
+    return jax.jit(f)
+
+
+@lru_cache(maxsize=None)
+def _jit_stream_decode(n_sym_bucket: int, viterbi_window: int = None,
+                       viterbi_metric: str = None):
+    """Dispatch 2 of the streaming chunk: row-select the decodable
+    lanes INSIDE the jit (the segment batch never re-crosses the host
+    link), the one-`lax.switch` mixed-rate decode at the stream's
+    fixed symbol bucket, and the vmapped masked-CRC check. The CRC
+    flags are always computed (noise next to the Viterbi), so one
+    compile serves both `check_fcs` modes — the fused-link rule."""
+    def f(segs, rows, ridx, nbits, npsdu):
+        clear = decode_data_mixed(segs[rows], ridx, nbits, n_sym_bucket,
+                                  viterbi_window, viterbi_metric)
+        return clear, crc_psdu_many_graph(clear, npsdu)
+    return jax.jit(f)
 
 
 def receive(samples, check_fcs: bool = False,

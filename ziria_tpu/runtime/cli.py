@@ -279,6 +279,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="force the per-frame encode/loopback loop "
                         "(the batched TX path's bit-identical "
                         "oracle); also via ZIRIA_BATCHED_TX=0")
+    p.add_argument("--streaming-rx", dest="streaming_rx",
+                   action="store_true", default=None,
+                   help="chunked one-dispatch streaming receiver for "
+                        "the library stream surface "
+                        "(framebatch.receive_stream): a long multi-"
+                        "frame capture is scanned in fixed overlapping "
+                        "chunks, each chunk costing <= 2 device "
+                        "dispatches (multi-peak detect + align + "
+                        "acquire + gather fused, then one mixed-rate "
+                        "decode), with the host<->device transfer "
+                        "double-buffered behind compute (the default; "
+                        "docs/architecture.md). Also via "
+                        "ZIRIA_STREAMING_RX=1")
+    p.add_argument("--no-streaming-rx", dest="streaming_rx",
+                   action="store_false",
+                   help="force the per-capture oracle over the same "
+                        "detected windows (>= 3 dispatches per frame "
+                        "— the streaming path's bit-identical "
+                        "contract); also via ZIRIA_STREAMING_RX=0")
     p.add_argument("--fused-link", dest="fused_link",
                    action="store_true", default=None,
                    help="ONE-dispatch fused loopback link "
@@ -652,6 +671,11 @@ def main(argv=None) -> int:
         # one-dispatch loopback vs its staged 5-dispatch oracle)
         overrides["ZIRIA_FUSED_LINK"] = \
             "1" if args.fused_link else "0"
+    if args.streaming_rx is not None:
+        # framebatch.streaming_rx_enabled reads this at call time
+        # (the chunked streaming receiver vs its per-capture oracle)
+        overrides["ZIRIA_STREAMING_RX"] = \
+            "1" if args.streaming_rx else "0"
     if not overrides:
         return _main_run(args)
     prev = {k: os.environ.get(k) for k in overrides}

@@ -84,11 +84,15 @@ class DispatchCount:
     :func:`count_dispatches` block is active. ``counts`` holds the
     per-site dispatch counts; ``times`` the per-site accumulated wall
     seconds from :func:`timed` sites (sites instrumented with bare
-    :func:`record` contribute counts only)."""
+    :func:`record` contribute counts only); ``gauges`` the per-label
+    high-water marks from :func:`record_gauge` sites (e.g. the
+    streaming receiver's in-flight chunk depth — a *level*, not an
+    event count, so it maxes rather than sums)."""
 
     def __init__(self) -> None:
         self.counts: Counter = Counter()
         self.times: Counter = Counter()      # label -> wall seconds
+        self.gauges: Dict[str, float] = {}   # label -> max level seen
 
     @property
     def total(self) -> int:
@@ -125,6 +129,21 @@ def record(label: str = "dispatch", n: int = 1,
             c.counts[label] += n
             if seconds is not None:
                 c.times[label] += seconds
+
+
+def record_gauge(label: str, value: float) -> None:
+    """Report the current *level* of an instrumented quantity (the
+    streaming receiver's in-flight dispatch depth). Active counters
+    keep the maximum level observed, so ``d.gauges["..."]`` after a
+    :func:`count_dispatches` block is the high-water mark — the number
+    that shows whether double-buffered overlap actually overlapped.
+    Free when no counter is active (one lock-free len check)."""
+    if not _ACTIVE:
+        return
+    with _LOCK:
+        for c in _ACTIVE:
+            if value > c.gauges.get(label, float("-inf")):
+                c.gauges[label] = value
 
 
 @contextmanager
