@@ -185,12 +185,15 @@ def test_pinned_baseline_reader(tmp_path, monkeypatch):
     assert pin["sps"] == 6401460.9
 
 
-def test_probe_hang_cached_within_invocation(monkeypatch):
+def test_probe_hang_cached_within_invocation(monkeypatch, tmp_path):
     """A probe TIMEOUT is definitive for the invocation (the tunnel is
     down, not flaking): no same-call retry, and a second _probe call
     reuses the cached negative — BENCH_r05 paid the same 90 s hang
     2-3x per run (~200 s wall) before this memo."""
     b = _bench()                       # fresh module: isolated memo
+    # isolate the persistent ledger: this test is about the IN-PROCESS
+    # memo, and it must neither read nor pollute the repo's ledger
+    monkeypatch.setattr(b, "PROBES_PATH", str(tmp_path / "p.jsonl"))
     calls = []
 
     def fake_child(argv, tmo):
@@ -207,11 +210,136 @@ def test_probe_hang_cached_within_invocation(monkeypatch):
     assert len(calls) == 1             # ...and never re-paid
 
 
-def test_probe_transient_rc_still_retries(monkeypatch):
+def _write_probes(tmp_path, recs):
+    p = tmp_path / "probes.jsonl"
+    with open(p, "w") as f:
+        for r in recs:
+            f.write(r if isinstance(r, str) else json.dumps(r))
+            f.write("\n")
+    return str(p)
+
+
+def test_probe_ledger_fail_within_ttl_skips_probe(tmp_path, monkeypatch):
+    """ISSUE 6 satellite: a ledger failure younger than the TTL is
+    trusted WITHOUT re-probing — the 90 s hang is paid once per TTL
+    across INVOCATIONS, not once per invocation (PR 5 only memoized
+    within one)."""
+    b = _bench()
+    import time as _t
+    now = _t.time()
+    monkeypatch.setattr(b, "PROBES_PATH", _write_probes(tmp_path, [
+        {"t": "garbage-iso", "probe": "fail"},          # unparseable t
+        "not json at all",
+        {"probe": "busy", "unix": now - 10},            # busy != fail
+        {"probe": "fail", "unix": now - 100, "err": "hang"},
+    ]))
+    calls = []
+    monkeypatch.setattr(b, "_run_one_child",
+                        lambda argv, tmo: calls.append(argv) or (0, "", ""))
+    ok, err = b._probe(now + 10_000)
+    assert not ok and "skipped" in err and calls == []
+    # and the negative memoizes for the invocation like a real probe
+    ok2, err2 = b._probe(now + 10_000)
+    assert not ok2 and calls == []
+
+
+def test_probe_ledger_ok_supersedes_fail(tmp_path, monkeypatch):
+    """A later "ok" (e.g. the watcher's) supersedes an earlier fail:
+    the tunnel came back, so the probe runs."""
+    b = _bench()
+    import time as _t
+    now = _t.time()
+    monkeypatch.setattr(b, "PROBES_PATH", _write_probes(tmp_path, [
+        {"probe": "fail", "unix": now - 300},
+        {"probe": "ok", "unix": now - 50},
+    ]))
+    calls = []
+
+    def fake_child(argv, tmo):
+        calls.append(argv)
+        return 0, "", ""
+
+    monkeypatch.setattr(b, "_run_one_child", fake_child)
+    ok, _err = b._probe(now + 10_000)
+    assert ok and len(calls) == 1
+
+
+def test_probe_ledger_stale_or_disabled_probes_again(tmp_path,
+                                                     monkeypatch):
+    b = _bench()
+    import time as _t
+    now = _t.time()
+    path = _write_probes(tmp_path, [
+        {"probe": "fail", "unix": now - 99999}])        # beyond TTL
+    monkeypatch.setattr(b, "PROBES_PATH", path)
+    calls = []
+    monkeypatch.setattr(
+        b, "_run_one_child",
+        lambda argv, tmo: calls.append(argv) or (0, "", ""))
+    ok, _err = b._probe(now + 10_000)
+    assert ok and len(calls) == 1
+    # TTL=0 disables the ledger read entirely, fresh failure or not
+    b2 = _bench()
+    monkeypatch.setattr(b2, "PROBES_PATH", _write_probes(
+        tmp_path, [{"probe": "fail", "unix": now - 5}]))
+    monkeypatch.setenv("BENCH_PROBE_NEG_TTL", "0")
+    calls2 = []
+    monkeypatch.setattr(
+        b2, "_run_one_child",
+        lambda argv, tmo: calls2.append(argv) or (0, "", ""))
+    ok2, _e = b2._probe(now + 10_000)
+    assert ok2 and len(calls2) == 1
+
+
+def test_probe_outcomes_persist_to_ledger(tmp_path, monkeypatch):
+    """A probe hang APPENDS a fail record (with unix stamp + err) in
+    the watcher's line format, and a success appends ok — so the next
+    invocation (and the availability ledger) both see it."""
+    b = _bench()
+    path = str(tmp_path / "probes.jsonl")
+    monkeypatch.setattr(b, "PROBES_PATH", path)
+    monkeypatch.setattr(b, "_run_one_child",
+                        lambda argv, tmo: (None, "", ""))  # hang
+    import time as _t
+    ok, _err = b._probe(_t.time() + 10_000)
+    assert not ok
+    recs = [json.loads(x) for x in open(path)]
+    assert recs[-1]["probe"] == "fail" and "unix" in recs[-1] \
+        and "timeout" in recs[-1]["err"] and "t" in recs[-1]
+    b2 = _bench()
+    monkeypatch.setattr(b2, "PROBES_PATH", path)
+    monkeypatch.setenv("BENCH_PROBE_NEG_TTL", "0")   # force a re-probe
+    monkeypatch.setattr(b2, "_run_one_child",
+                        lambda argv, tmo: (0, "", ""))
+    ok2, _e = b2._probe(_t.time() + 10_000)
+    assert ok2
+    recs = [json.loads(x) for x in open(path)]
+    assert recs[-1]["probe"] == "ok"
+
+
+def test_probe_ledger_parses_watcher_iso_lines(tmp_path, monkeypatch):
+    """The watcher writes {"t": ISO-8601, "probe": "fail"} with no
+    unix stamp; those lines must gate bench probes too."""
+    b = _bench()
+    import time as _t
+    now = _t.time()
+    iso = _t.strftime("%Y-%m-%dT%H:%M:%SZ", _t.gmtime(now - 60))
+    monkeypatch.setattr(b, "PROBES_PATH", _write_probes(
+        tmp_path, [{"t": iso, "probe": "fail"}]))
+    calls = []
+    monkeypatch.setattr(
+        b, "_run_one_child",
+        lambda argv, tmo: calls.append(argv) or (0, "", ""))
+    ok, err = b._probe(now + 10_000)
+    assert not ok and "skipped" in err and calls == []
+
+
+def test_probe_transient_rc_still_retries(monkeypatch, tmp_path):
     """A non-zero exit stays a transient: the retry loop (which fixed
     BENCH_r01) is untouched, and a retry that SUCCEEDS leaves no
     negative memo behind."""
     b = _bench()
+    monkeypatch.setattr(b, "PROBES_PATH", str(tmp_path / "p.jsonl"))
     calls = []
 
     def fake_child(argv, tmo):

@@ -182,8 +182,10 @@ def test_saturation_touches_only_floored_states():
 
 def test_metric_dtype_validation():
     x = np.zeros((2, 64, 2), np.float32)
+    # int8 became a LEGAL mode in ISSUE 6 (tests/test_viterbi_radix4);
+    # the rejection contract moves to genuinely-unknown dtypes
     with pytest.raises(ValueError, match="metric_dtype"):
-        viterbi.viterbi_decode(x[0], metric_dtype="int8")
+        viterbi.viterbi_decode(x[0], metric_dtype="int4")
     with pytest.raises(ValueError, match="metric_dtype"):
         viterbi_pallas.viterbi_decode_batch(x, metric_dtype="bfloat16")
     # None and the explicit default are the same legal surface
@@ -215,11 +217,14 @@ def test_env_mode_reaches_staged_viterbi_soft(monkeypatch):
 
     monkeypatch.delenv("ZIRIA_VITERBI_WINDOW", raising=False)
     monkeypatch.delenv("ZIRIA_VITERBI_METRIC", raising=False)
-    assert externals.viterbi_mode() == (0, "float32")
+    monkeypatch.delenv("ZIRIA_VITERBI_RADIX", raising=False)
+    assert externals.viterbi_mode() == (0, "float32", 2)
     monkeypatch.setenv("ZIRIA_VITERBI_METRIC", "int16")
     monkeypatch.setenv("ZIRIA_VITERBI_WINDOW", "512")
-    assert externals.viterbi_mode() == (512, "int16")
-    monkeypatch.setenv("ZIRIA_VITERBI_METRIC", "int8")
+    assert externals.viterbi_mode() == (512, "int16", 2)
+    # int8 became a legal metric in ISSUE 6; the reject contract moves
+    # to genuinely-unknown dtypes
+    monkeypatch.setenv("ZIRIA_VITERBI_METRIC", "int4")
     with pytest.raises(ValueError, match="ZIRIA_VITERBI_METRIC"):
         externals.viterbi_mode()
     monkeypatch.setenv("ZIRIA_VITERBI_METRIC", "int16")

@@ -53,6 +53,18 @@ smoke or a manual chip window:
   per point), error counts gated integer-identical, sweep points/s
   and samples/s recorded.
 
+- ``viterbi_breakdown`` (ISSUE 6 satellite): the decode step cut into
+  front-end-only / ACS-only / traceback-only / full with the marginal-K
+  method — the measured answer to "dependency-chain-bound, but WHERE?".
+
+- ``viterbi_kernel_stats`` (ISSUE 6 tentpole): per-lever decode-core
+  samples/s for the rebuilt ACS (radix-4, int16, int8+LUT, fused
+  demap front end, stacked), dispatch counts + per-site times from
+  utils/dispatch, identity-gated: radix-4 exactly bit-identical vs
+  the float32 radix-2 oracle on noisy inputs, the fused levers within
+  a vanishing mismatch budget (their renorm cadence differs), int8
+  gated on its BER envelope.
+
 - ``streaming_stats`` (ISSUE 5 tentpole): a long multi-frame I/Q
   stream (``link.stream_many``: all 8 rates, random gaps, CFO, delay,
   AWGN) through ``framebatch.receive_stream`` — <= 2 dispatches per
@@ -512,6 +524,249 @@ def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
     }
 
 
+def viterbi_breakdown(B=128, n_bytes=1000, rate_mbps=54, k1=4, k2=12):
+    """ACS-only vs traceback-only vs front-end-only vs full decode at
+    the bench shape — the answer to bench.py's open question ("the
+    decode is dependency-chain-bound, but WHERE?"): the decompose
+    stage bounds front end vs Viterbi; this splits the Viterbi into
+    its two Pallas kernels. Each piece is timed with the same
+    marginal-K device-loop method as the headline (runtime-zero data
+    feedback so the body cannot be hoisted), so the four numbers are
+    directly comparable. Returns a flat dict."""
+    import jax
+    import jax.numpy as jnp
+
+    from ziria_tpu.ops import viterbi_pallas as vp
+    from ziria_tpu.phy.wifi import rx, tx
+    from ziria_tpu.phy.wifi.params import RATES, n_symbols
+
+    rate = RATES[rate_mbps]
+    n_sym = n_symbols(n_bytes, rate)
+    rng = np.random.default_rng(19)
+    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+    frame = np.asarray(tx.encode_frame(psdu, rate_mbps))
+    frames = jnp.asarray(np.broadcast_to(
+        frame, (B,) + frame.shape).copy())
+    interpret = jax.default_backend() != "tpu"
+    n_bits = n_sym * rate.n_dbps
+
+    def marginal(loop, *args):
+        t1 = _timed(loop, *args, jnp.int32(k1))
+        t2 = _timed(loop, *args, jnp.int32(k2))
+        return max((t2 - t1) / (k2 - k1), 1e-9)
+
+    @jax.jit
+    def front_k(f, k):
+        def body(_i, carry):
+            s, acc = carry
+            dep = jax.vmap(
+                lambda x: rx._decode_front(x, rate, n_sym))(f + s)
+            return dep[0, 0, 0] * 1e-30, acc + dep.sum() * 1e-30
+        return jax.lax.fori_loop(
+            0, k, body, (jnp.float32(0), jnp.float32(0)))[1]
+
+    dep0 = jax.jit(jax.vmap(
+        lambda x: rx._decode_front(x, rate, n_sym)))(frames)
+    # the ACS kernel's real input: lane tiles at the UNROLL multiple
+    tiles, _b = vp._to_tiles(jnp.asarray(dep0))
+    T = tiles.shape[1]
+    Tp = -(-T // vp.UNROLL) * vp.UNROLL
+    tiles = jnp.pad(tiles, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
+
+    @jax.jit
+    def acs_k(x, k):
+        def body(_i, carry):
+            s, acc = carry
+            _dec, metrics = vp._acs_tiles(x + s, interpret)
+            return metrics[0, 0, 0] * 1e-30, acc + metrics.sum() * 1e-30
+        return jax.lax.fori_loop(
+            0, k, body, (jnp.float32(0), jnp.float32(0)))[1]
+
+    dec0, met0 = jax.jit(
+        lambda x: vp._acs_tiles(x, interpret))(tiles)
+
+    @jax.jit
+    def tb_k(d, m, k):
+        def body(_i, carry):
+            s, acc = carry
+            bits = vp._traceback_tiles(d, m + s, interpret)
+            f = bits[0, 0, 0, 0].astype(jnp.float32)
+            return f * 1e-30, acc + f * 1e-30
+        return jax.lax.fori_loop(
+            0, k, body, (jnp.float32(0), jnp.float32(0)))[1]
+
+    @jax.jit
+    def full_k(f, k):
+        def body(_i, carry):
+            s, acc = carry
+            bits = rx.decode_data_batch(
+                f + s, rate, n_sym, 8 * n_bytes)[0]
+            s2 = bits[0, 0].astype(jnp.float32) * 1e-30
+            return s2, acc + bits.sum() * 1e-30
+        return jax.lax.fori_loop(
+            0, k, body, (jnp.float32(0), jnp.float32(0)))[1]
+
+    t_front = marginal(front_k, frames)
+    t_acs = marginal(acs_k, tiles)
+    t_tb = marginal(tb_k, dec0, met0)
+    t_full = marginal(full_k, frames)
+    return {
+        "batch": B, "frame_bytes": n_bytes, "rate_mbps": rate_mbps,
+        "frame_len": int(frame.shape[0]), "trellis_steps": int(n_bits),
+        "t_front_s": round(t_front, 6),
+        "t_acs_s": round(t_acs, 6),
+        "t_traceback_s": round(t_tb, 6),
+        "t_full_s": round(t_full, 6),
+        "front_frac": round(t_front / t_full, 3),
+        "acs_frac": round(t_acs / t_full, 3),
+        "traceback_frac": round(t_tb / t_full, 3),
+    }
+
+
+# the decode-core lever matrix viterbi_kernel_stats measures: kwargs
+# for rx.decode_data_batch per lever (radix-4 ACS, quantized metrics,
+# the fused in-kernel front end, and the stack)
+VITERBI_LEVERS = (
+    ("base", {}),
+    ("radix4", {"viterbi_radix": 4}),
+    ("int16", {"viterbi_metric": "int16"}),
+    ("int16_radix4", {"viterbi_metric": "int16", "viterbi_radix": 4}),
+    ("int8_lut", {"viterbi_metric": "int8"}),
+    ("fused_demap", {"fused_demap": True}),
+    ("fused_demap_radix4", {"fused_demap": True, "viterbi_radix": 4}),
+)
+
+
+def viterbi_kernel_stats(B=128, n_bytes=1000, rate_mbps=54,
+                         k1=4, k2=12, noise_sigma=0.35,
+                         levers=VITERBI_LEVERS):
+    """Per-lever decode-core stats (ISSUE 6): samples/s + marginal
+    step time for each lever of the rebuilt ACS (radix-4, int16,
+    int8+LUT, fused demap front end, and the radix-4+fused stack),
+    with dispatch counts and per-site wall times from utils/dispatch
+    and the identity gates the levers promise:
+
+    - every lever decodes the clean corpus to the TX bits (the bench
+      correctness gate, green for int8 too);
+    - on NOISY inputs, radix-4 / fused levers are gated BIT-IDENTICAL
+      against the float32 radix-2 oracle's output (their contract),
+      int16 against its own radix-2 twin, and int8 — whose contract is
+      statistical — against the f32 oracle's BER (delta recorded).
+
+    Returns a flat dict (bench.py's viterbi_kernel_stats stage stores
+    it verbatim and annotates roofline percentages per lever)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ziria_tpu.phy.wifi import rx, tx
+    from ziria_tpu.phy.wifi.params import RATES, n_symbols
+    from ziria_tpu.utils.dispatch import count_dispatches, timed
+
+    rate = RATES[rate_mbps]
+    n_sym = n_symbols(n_bytes, rate)
+    n_psdu_bits = 8 * n_bytes
+    rng = np.random.default_rng(21)
+    psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+    frame = np.asarray(tx.encode_frame(psdu, rate_mbps))
+    from ziria_tpu.utils.bits import bytes_to_bits
+    want = np.asarray(bytes_to_bits(psdu))
+    frames = jnp.asarray(np.broadcast_to(
+        frame, (B,) + frame.shape).copy())
+    # a small noisy batch at operating SNR for the oracle gates (the
+    # clean batch decodes perfectly under EVERY lever, which gates
+    # correctness but cannot distinguish bit-identity from luck)
+    Bn = min(B, 8)
+    noisy = (np.broadcast_to(frame, (Bn,) + frame.shape)
+             + rng.normal(0, noise_sigma, (Bn,) + frame.shape)
+             ).astype(np.float32)
+    noisy = jnp.asarray(noisy)
+
+    def decode(f, **kw):
+        return rx.decode_data_batch(f, rate, n_sym, n_psdu_bits,
+                                    **kw)[0]
+
+    out = {"batch": B, "frame_bytes": n_bytes, "rate_mbps": rate_mbps,
+           "frame_len": int(frame.shape[0]),
+           "noise_sigma": noise_sigma}
+    noisy_bits = {}
+    with count_dispatches() as d:
+        for name, kw in levers:
+            with timed(f"viterbi.{name}"):
+                got = np.asarray(jax.jit(
+                    lambda f, _kw=kw: decode(f, **_kw))(frames))
+            assert np.array_equal(got[0], want) \
+                and np.array_equal(got[-1], want), \
+                f"{name} failed the clean correctness gate"
+            noisy_bits[name] = np.asarray(jax.jit(
+                lambda f, _kw=kw: decode(f, **_kw))(noisy))
+    out["dispatch_times_ms"] = d.times_ms()
+    out["dispatches"] = d.total
+
+    # identity gates on the noisy corpus. radix4 is PROVABLY identical
+    # to the oracle (same renorm cadence, same expression trees), so
+    # its gate is exact. The fused levers share the expression trees
+    # but renorm at the symbol-block cadence instead of every UNROLL
+    # steps — f32 renorm rounding can in principle flip a sub-epsilon
+    # near-tie at operating noise, so their gate records the mismatch
+    # fraction and asserts it stays within a vanishing budget instead
+    # of erroring the whole stage on one flipped razor-edge bit.
+    base = noisy_bits["base"]
+    for name in ("radix4",):
+        if name not in noisy_bits:
+            continue                   # lever not in this run's matrix
+        same = bool(np.array_equal(noisy_bits[name], base))
+        out[f"{name}_bit_identical"] = same
+        assert same, f"{name} diverged from the float32 radix-2 oracle"
+    for name in ("fused_demap", "fused_demap_radix4"):
+        if name not in noisy_bits:
+            continue
+        frac = float((noisy_bits[name] != base).mean())
+        out[f"{name}_bit_identical"] = frac == 0.0
+        out[f"{name}_mismatch_frac"] = round(frac, 8)
+        assert frac <= 1e-3, \
+            f"{name} diverged from the unfused front end ({frac:.2e})"
+    if "int16_radix4" in noisy_bits and "int16" in noisy_bits:
+        same16 = bool(np.array_equal(noisy_bits["int16_radix4"],
+                                     noisy_bits["int16"]))
+        out["int16_radix4_bit_identical"] = same16
+        assert same16, "int16 radix-4 diverged from its radix-2 twin"
+    ber_f32 = float((base != want[None]).mean())
+    out["ber_f32"] = round(ber_f32, 6)
+    if "int8_lut" in noisy_bits:
+        ber_i8 = float((noisy_bits["int8_lut"] != want[None]).mean())
+        out["ber_int8"] = round(ber_i8, 6)
+        out["ber_int8_delta"] = round(ber_i8 - ber_f32, 6)
+        # the int8 contract is its BER ENVELOPE (same bound as
+        # tests/test_viterbi_radix4.test_int8_ber_guard): a saturation
+        # or LUT regression must fail the stage, not report green
+        assert abs(ber_i8 - ber_f32) < 0.05 * max(ber_f32, 1e-9) + 4e-3, \
+            f"int8 BER {ber_i8:.4f} outside envelope vs f32 {ber_f32:.4f}"
+        out["int8_ber_gate"] = True
+
+    # per-lever marginal step time (the headline's tunnel-cancelling
+    # K-spread method)
+    for name, kw in levers:
+        @jax.jit
+        def loop(x, k, _kw=kw):
+            def body(_i, carry):
+                s, acc = carry
+                bits = decode(x + s, **_kw)
+                s2 = bits[0, 0].astype(jnp.float32) * 1e-30
+                return s2, acc + bits.sum() * 1e-30
+            return jax.lax.fori_loop(
+                0, k, body, (jnp.float32(0), jnp.float32(0)))[1]
+
+        t_1 = _timed(loop, frames, jnp.int32(k1))
+        t_2 = _timed(loop, frames, jnp.int32(k2))
+        t_step = max((t_2 - t_1) / (k2 - k1), 1e-9)
+        out[f"t_step_{name}_s"] = round(t_step, 6)
+        out[f"sps_{name}"] = round(B * frame.shape[0] / t_step, 1)
+    for name, _kw in levers[1:]:
+        out[f"{name}_over_base"] = round(
+            out[f"t_step_{name}_s"] / out["t_step_base_s"], 3)
+    return out
+
+
 def main():
     import jax
 
@@ -527,6 +782,13 @@ def main():
            "device_kind": getattr(dev, "device_kind", "?")}
     if smoke:     # shrunk sizes: prove the path, not the number
         out["quantized"] = quantized_sweep(B=8, n_bytes=100, k1=2, k2=4)
+        out["viterbi_breakdown"] = viterbi_breakdown(
+            B=8, n_bytes=100, k1=2, k2=4)
+        # fused levers dropped on CPU like bench.py's smoke stage: the
+        # rate-54 fused kernel is a 216-step unrolled interpret-mode
+        # program (minutes on CPU, milliseconds of Mosaic on chip)
+        out["viterbi_kernel_stats"] = viterbi_kernel_stats(
+            B=8, n_bytes=100, k1=2, k2=4, levers=VITERBI_LEVERS[:5])
         out["mixed_dispatch"] = mixed_dispatch_stats(n_bytes=60)
         out["batched_acquire"] = batched_acquire_stats(n_bytes=60)
         out["link_loopback"] = link_loopback_stats(n_bytes=24)
@@ -536,6 +798,8 @@ def main():
         out["streaming_rx"] = streaming_stats(n_frames=8)
     else:
         out["quantized"] = quantized_sweep()
+        out["viterbi_breakdown"] = viterbi_breakdown()
+        out["viterbi_kernel_stats"] = viterbi_kernel_stats()
         out["mixed_dispatch"] = mixed_dispatch_stats()
         out["mixed_dispatch_i16"] = mixed_dispatch_stats(
             viterbi_metric="int16")

@@ -197,6 +197,7 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
                  max_samples: int = 1 << 16,
                  viterbi_window: int = None,
                  viterbi_metric: str = None,
+                 viterbi_radix: int = None,
                  batched_acquire: Optional[bool] = None) -> List[Any]:
     """Frame-batched library receiver: N independent captures -> N
     :class:`rx.RxResult`s in O(1) device dispatches — acquire ->
@@ -221,6 +222,11 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
     lane, including no-detect / bad-parity / truncated lanes; lane
     counts pad to the next power of two (lane 0 repeated) so XLA
     compiles O(log N) batch variants.
+
+    ``viterbi_radix=4`` runs the mixed decode's Pallas ACS two trellis
+    steps per iteration (bit-identical); the fused-demap front end
+    does not apply to the mixed decode (rate-static tables — see
+    rx.decode_data_mixed), so there is no knob for it here.
     """
     import os
 
@@ -258,12 +264,14 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
         segs = jnp.stack([_rx._padded_segment(a, n_sym_b)
                           for _i, a in padded])
     return _mixed_decode_tail(acqs, padded, segs, n_sym_b, results,
-                              check_fcs, viterbi_window, viterbi_metric)
+                              check_fcs, viterbi_window, viterbi_metric,
+                              viterbi_radix)
 
 
 def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
                        results: List[Any], check_fcs: bool,
-                       viterbi_window, viterbi_metric):
+                       viterbi_window, viterbi_metric,
+                       viterbi_radix=None):
     """The shared tail of every batched receive surface: ONE
     mixed-rate decode dispatch over the lane-padded segments, plus —
     when FCS checking is on — ONE vmapped masked-CRC dispatch at the
@@ -278,6 +286,7 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
     the ridx/nbits rows can never disagree with the segment rows."""
     import jax.numpy as jnp
 
+    from ziria_tpu.ops.viterbi import _check_radix
     from ziria_tpu.phy.wifi import rx as _rx
     from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
     from ziria_tpu.utils import dispatch
@@ -288,7 +297,8 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
         [a.n_sym * RATES[a.rate_mbps].n_dbps for _i, a in padded],
         jnp.int32)
     dec = _rx._jit_decode_data_mixed(n_sym_b, viterbi_window,
-                                     viterbi_metric)
+                                     viterbi_metric,
+                                     _check_radix(viterbi_radix))
     with dispatch.timed("rx.decode_mixed"):
         clear_dev = dec(segs, ridx, nbits)
     crc_b = None
@@ -309,7 +319,8 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
 
 def receive_many_device(x_dev, n_lanes: int, check_fcs: bool = False,
                         viterbi_window: int = None,
-                        viterbi_metric: str = None) -> List[Any]:
+                        viterbi_metric: str = None,
+                        viterbi_radix: int = None) -> List[Any]:
     """Batched receive over an ALREADY device-resident capture batch —
     the RX side of the loopback link (phy/link.py): the channel's
     output feeds acquisition without the samples ever crossing the
@@ -340,7 +351,8 @@ def receive_many_device(x_dev, n_lanes: int, check_fcs: bool = False,
     segs = _rx.gather_segments_many(
         x_dev, [a for _i, a in padded], n_sym_b)
     return _mixed_decode_tail(lanes, padded, segs, n_sym_b, results,
-                              check_fcs, viterbi_window, viterbi_metric)
+                              check_fcs, viterbi_window, viterbi_metric,
+                              viterbi_radix)
 
 
 # ------------------------------------------------------ streaming receiver
@@ -428,7 +440,9 @@ class StreamReceiver:
                  threshold: float = 0.75, min_run: int = 33,
                  dead_zone: int = 320, viterbi_window: int = None,
                  viterbi_metric: str = None,
+                 viterbi_radix: int = None,
                  streaming: Optional[bool] = None):
+        from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
 
         if frame_len != _rx._stream_bucket(frame_len):
@@ -453,6 +467,9 @@ class StreamReceiver:
         self.check_fcs = check_fcs
         self.viterbi_window = viterbi_window
         self.viterbi_metric = viterbi_metric
+        # resolved ONCE at construction: the radix is part of the
+        # stream's fixed compiled geometry (decode jit cache key)
+        self.viterbi_radix = _check_radix(viterbi_radix)
         self.streaming = streaming_rx_enabled(streaming)
         self._jit1 = _rx._jit_stream_chunk(
             self.k, self.frame_len, self.n_sym_bucket,
@@ -595,7 +612,8 @@ class StreamReceiver:
                 out.append(StreamFrame(abs_start, _rx.receive(
                     win, check_fcs=self.check_fcs,
                     viterbi_window=self.viterbi_window,
-                    viterbi_metric=self.viterbi_metric)))
+                    viterbi_metric=self.viterbi_metric,
+                    viterbi_radix=self.viterbi_radix)))
             self._emitted += len(out)
             return out
 
@@ -627,7 +645,8 @@ class StreamReceiver:
             npsdu = row_pad([8 * lb for _s, _j, _m, _n, lb in lanes])
             dec = _rx._jit_stream_decode(self.n_sym_bucket,
                                          self.viterbi_window,
-                                         self.viterbi_metric)
+                                         self.viterbi_metric,
+                                         self.viterbi_radix)
             with dispatch.timed("rx.stream_decode"):
                 clear, crc = dec(segs, rows, ridx, nbits, npsdu)
             clear = np.asarray(clear, np.uint8)
@@ -649,6 +668,7 @@ def receive_stream(samples, chunk_len: int = 1 << 13,
                    threshold: float = 0.75, min_run: int = 33,
                    dead_zone: int = 320, viterbi_window: int = None,
                    viterbi_metric: str = None,
+                   viterbi_radix: int = None,
                    streaming: Optional[bool] = None):
     """Decode every frame of a long multi-frame sample stream in
     O(chunks) device dispatches (<= 2 per chunk; 1 for all-noise
@@ -672,6 +692,7 @@ def receive_stream(samples, chunk_len: int = 1 << 13,
                         min_run=min_run, dead_zone=dead_zone,
                         viterbi_window=viterbi_window,
                         viterbi_metric=viterbi_metric,
+                        viterbi_radix=viterbi_radix,
                         streaming=streaming)
     frames = sr.push(samples)
     frames += sr.flush()

@@ -200,21 +200,23 @@ EXTERNALS: Dict[str, Callable] = {
 
 
 def viterbi_mode() -> tuple:
-    """The process-wide staged-decode mode: ``(window, metric_dtype)``
-    from ZIRIA_VITERBI_WINDOW / ZIRIA_VITERBI_METRIC.
+    """The process-wide staged-decode mode: ``(window, metric_dtype,
+    radix)`` from ZIRIA_VITERBI_WINDOW / ZIRIA_VITERBI_METRIC /
+    ZIRIA_VITERBI_RADIX.
 
-    ONE reader for the env pair so the trace-time read in
+    ONE reader for the env triple so the trace-time read in
     ``_viterbi_soft`` and the backend compile-cache keys
     (backend/chunked ``_get_fn``, backend/hybrid ``_JitDo``) can never
     disagree: the mode is part of every cached program's key, so an
     in-process change after tracing re-traces instead of silently
     keeping the old decode mode (ADVICE r5 #1 — a code comment used to
     be the only guard). An unparseable window degrades to 0 (off, the
-    safe default); an unknown metric raises — the quantized kernel is
-    an opt-in accuracy trade that must never be silently dropped."""
+    safe default); an unknown metric or radix raises — the quantized
+    kernels are an opt-in accuracy trade and the radix an opt-in
+    kernel rewrite, neither of which may be silently dropped."""
     import os as _os
 
-    from ziria_tpu.ops.viterbi import METRIC_DTYPES
+    from ziria_tpu.ops.viterbi import METRIC_DTYPES, _check_radix
     try:
         win = int(_os.environ.get("ZIRIA_VITERBI_WINDOW", "0"))
     except ValueError:
@@ -223,7 +225,7 @@ def viterbi_mode() -> tuple:
     if md not in METRIC_DTYPES:
         raise ValueError(
             f"ZIRIA_VITERBI_METRIC={md!r} is not one of {METRIC_DTYPES}")
-    return win, md
+    return win, md, _check_radix(None)
 
 
 def _viterbi_soft(llrs, npairs, nbits):
@@ -265,16 +267,18 @@ def _viterbi_soft(llrs, npairs, nbits):
         # cache keys — changing the env after tracing re-traces.
         import jax.numpy as jnp
         arr = jnp.asarray(llrs, jnp.float32)
-        win, metric = viterbi_mode()
+        win, metric, radix = viterbi_mode()
         from ziria_tpu.ops import viterbi_pallas as _vp
         if win > 0 and npairs > win + 2 * _vp.DEFAULT_WINDOW_OVERLAP:
             # only frames long enough to actually window: short
             # decodes (e.g. the 48-step SIGNAL field on the sync hot
             # path) keep the scan kernel — the flag is a pure
-            # optimization, never a kernel-launch tax (review r5)
+            # optimization, never a kernel-launch tax (review r5).
+            # radix reaches the windowed path's Pallas engine; the
+            # unwindowed scan decode below has no radix by definition
             bits = _vp.viterbi_decode_batch_windowed(
                 arr[None, : 2 * npairs], n_bits=nbits, window=win,
-                metric_dtype=metric)[0]
+                metric_dtype=metric, radix=radix)[0]
         else:
             from ziria_tpu.ops.viterbi import viterbi_decode
             bits = viterbi_decode(arr[: 2 * npairs], n_bits=nbits,

@@ -49,6 +49,34 @@ def demap(syms, n_bpsc: int, gain=None) -> jnp.ndarray:
     return bits.reshape(syms.shape[:-2] + (syms.shape[-2] * n_bpsc,))
 
 
+def demap_bit_layout(n_bpsc: int):
+    """Static per-bit demap descriptors for the IN-KERNEL fused front
+    end (ops/viterbi_pallas' demap→deinterleave→depuncture prologue).
+
+    For bit index b within one subcarrier's ``n_bpsc`` demapped LLRs,
+    returns ``(comp, lev, amp)`` numpy arrays: ``comp[b]`` selects the
+    component (0 = I, 1 = Q), ``lev[b]`` the level-domain formula the
+    module docstring lists (0: ``x``; 1: ``amp - |x|``;
+    2: ``2 - ||x| - 4|``), ``amp[b]`` the level-1 constant. The tables
+    live HERE, next to :func:`demap`, so the kernel's formulas and the
+    XLA demap can never drift — tests pin the fused decode bit-for-bit
+    against the demap()+deinterleave()+depuncture() pipeline."""
+    if n_bpsc == 1:
+        comp, lev, amp = [0], [0], [0.0]
+    elif n_bpsc == 2:
+        comp, lev, amp = [0, 1], [0, 0], [0.0, 0.0]
+    elif n_bpsc == 4:
+        comp, lev, amp = [0, 0, 1, 1], [0, 1, 0, 1], [0.0, 2.0, 0.0, 2.0]
+    elif n_bpsc == 6:
+        comp = [0, 0, 0, 1, 1, 1]
+        lev = [0, 1, 2, 0, 1, 2]
+        amp = [0.0, 4.0, 2.0, 0.0, 4.0, 2.0]
+    else:
+        raise ValueError(f"unsupported n_bpsc {n_bpsc}")
+    return (np.asarray(comp, np.int32), np.asarray(lev, np.int32),
+            np.asarray(amp, np.float32))
+
+
 def np_demap_hard_ref(syms_c: np.ndarray, n_bpsc: int) -> np.ndarray:
     """Independent hard-decision oracle: nearest constellation point via
     the modulator's own tables, returning its bit label. Tests only."""

@@ -43,6 +43,21 @@ def deinterleave_perm(n_cbps: int, n_bpsc: int) -> np.ndarray:
     return inv
 
 
+@lru_cache(maxsize=None)
+def deinterleave_slots(n_cbps: int, n_bpsc: int):
+    """(subcarrier, bit) source of each DEinterleaved soft value — the
+    static index view of :func:`deinterleave` the in-kernel fused
+    front end (ops/viterbi_pallas) bakes into its one-hot gather
+    tables. Position ``q`` of the per-symbol deinterleaved stream
+    reads demapped LLR ``r = deinterleave_perm[q]``, and demap's
+    ``(..., 48 * n_bpsc)`` layout puts subcarrier ``r // n_bpsc`` bit
+    ``r % n_bpsc`` there. Returns ``(sub, bit)`` int32 arrays of
+    length ``n_cbps``."""
+    perm = deinterleave_perm(n_cbps, n_bpsc)
+    return (perm // n_bpsc).astype(np.int32), \
+        (perm % n_bpsc).astype(np.int32)
+
+
 def interleave(bits, n_cbps: int, n_bpsc: int) -> jnp.ndarray:
     """Interleave a stream of whole symbols: (..., m*n_cbps) -> same shape."""
     return _permute(bits, interleave_perm(n_cbps, n_bpsc), n_cbps)

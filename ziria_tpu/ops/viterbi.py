@@ -69,7 +69,30 @@ _PRED, _OUT_A, _OUT_B = _edge_tables()
 
 QUANT_MAX = 127                  # 8-bit soft values, like SORA's bricks
 I16_MIN, I16_MAX = -(1 << 15), (1 << 15) - 1
-METRIC_DTYPES = ("float32", "int16")
+
+# int8 saturating metrics — one storage level below the int16 path.
+# The soft values quantize to +-INT8_QUANT_MAX = 15 (4-bit soft
+# decisions, the classic hardware-decoder operating point): coarser
+# than the int16 path's +-127 because the int8 rail at -128 is
+# shallow — the renormed max sits at 0 and a state 128/(2*qmax) ≈ 4
+# worst-case branch metrics behind saturates. Measured across the
+# operating range, that clip never touches a surviving path (the
+# kernel's block-cadence renorm keeps contenders well clear of the
+# rail; tools/rx_dispatch_bench.viterbi_kernel_stats gates it), but
+# unlike int16 there is no PROOF it cannot, and the 4-bit rounding
+# itself legitimately moves near-tie decisions vs the f32 decode on
+# raw inputs — so the int8 contract is the statistical BER envelope
+# (tests/test_viterbi_radix4.py), not bit identity.
+INT8_QUANT_MAX = 15
+I8_MIN, I8_MAX = -(1 << 7), (1 << 7) - 1
+METRIC_DTYPES = ("float32", "int16", "int8")
+
+# radix of the Pallas ACS sweep: 2 = one trellis step per kernel
+# iteration (the oracle), 4 = two steps fused per iteration (butterfly
+# pairs collapsed — half the sequential dependency chain), decode
+# bit-identical to radix 2 at float32 and int16 by construction
+# (ops/viterbi_pallas.py derives it). The lax.scan decoders ignore it.
+RADIXES = (2, 4)
 
 
 def quantize_llrs(llrs, qmax: int = QUANT_MAX):
@@ -101,6 +124,31 @@ def _check_metric_dtype(metric_dtype):
         raise ValueError(
             f"metric_dtype {metric_dtype!r} is not one of {METRIC_DTYPES}")
     return md
+
+
+def _check_radix(radix) -> int:
+    """Validate/resolve the ACS radix knob. ``None`` reads the
+    ZIRIA_VITERBI_RADIX env default (2 when unset — the oracle). The
+    resolved integer is what the jit-factory caches key on, so every
+    surface resolves BEFORE building a cache key (the viterbi_metric
+    discipline: an env change after tracing must re-trace, never
+    silently reuse the other radix's program)."""
+    from_env = radix is None
+    if from_env:
+        import os
+        raw = os.environ.get("ZIRIA_VITERBI_RADIX") or "2"
+        try:
+            radix = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"ZIRIA_VITERBI_RADIX={raw!r} is not one of {RADIXES}")
+    radix = int(radix)
+    if radix not in RADIXES:
+        if from_env:
+            raise ValueError(
+                f"ZIRIA_VITERBI_RADIX={radix!r} is not one of {RADIXES}")
+        raise ValueError(f"viterbi radix {radix!r} is not one of {RADIXES}")
+    return radix
 
 
 def viterbi_decode_int16(qllrs, n_bits: int = None) -> jnp.ndarray:
@@ -147,6 +195,48 @@ def viterbi_decode_int16(qllrs, n_bits: int = None) -> jnp.ndarray:
     return bits
 
 
+def viterbi_decode_int8(qllrs, n_bits: int = None) -> jnp.ndarray:
+    """Decode pre-quantized int LLR pairs (|q| <= INT8_QUANT_MAX) with
+    int8 saturating metrics — the readable lax.scan REFERENCE of the
+    int8 discipline. Arithmetic runs in int32; every renormalized
+    metric saturates into [I8_MIN, I8_MAX] (per step here; the Pallas
+    kernel saturates at its block cadence — a strictly SOFTER clip).
+    The int8 rail is shallow enough that clipping can, on adversarial
+    inputs, touch states that later matter, which is why this path's
+    contract is a BER envelope rather than the int16 path's bit
+    identity (docs/quantized_viterbi.md §int8)."""
+    q = jnp.asarray(qllrs, jnp.int32)
+    if q.ndim == 1:
+        q = q.reshape(-1, 2)
+
+    pred = jnp.asarray(_PRED)
+    out_a = jnp.asarray(_OUT_A, np.float32).astype(jnp.int32)
+    out_b = jnp.asarray(_OUT_B, np.float32).astype(jnp.int32)
+
+    init = jnp.full((N_STATES,), I8_MIN, jnp.int32).at[0].set(0)
+
+    def acs(metrics, llr):
+        cand = metrics[pred] + out_a * llr[0] + out_b * llr[1]
+        best = jnp.argmax(cand, axis=1).astype(jnp.uint8)
+        new = jnp.max(cand, axis=1)
+        new = new - jnp.max(new)           # renormalize: max pinned at 0
+        new = jnp.clip(new, I8_MIN, I8_MAX)     # saturating int8 store
+        return new, best
+
+    metrics, decisions = jax.lax.scan(acs, init, q)
+    end_state = jnp.argmax(metrics).astype(jnp.int32)
+
+    def back(state, dec):
+        bit = (state >> 5).astype(jnp.uint8)
+        prev = pred[state, dec[state]]
+        return prev, bit
+
+    _, bits = jax.lax.scan(back, end_state, decisions, reverse=True)
+    if n_bits is not None:
+        bits = bits[:n_bits]
+    return bits
+
+
 def viterbi_decode(llrs, n_bits: int = None,
                    metric_dtype: str = None) -> jnp.ndarray:
     """Decode soft values.
@@ -163,9 +253,13 @@ def viterbi_decode(llrs, n_bits: int = None,
     decodes with int16 saturating metrics — the SORA trade; see
     viterbi_decode_int16 for the semantics.
     """
-    if _check_metric_dtype(metric_dtype) == "int16":
+    md = _check_metric_dtype(metric_dtype)
+    if md == "int16":
         q, _scale = quantize_llrs(llrs)
         return viterbi_decode_int16(q, n_bits)
+    if md == "int8":
+        q, _scale = quantize_llrs(llrs, qmax=INT8_QUANT_MAX)
+        return viterbi_decode_int8(q, n_bits)
     llrs = jnp.asarray(llrs, jnp.float32)
     if llrs.ndim == 1:
         llrs = llrs.reshape(-1, 2)

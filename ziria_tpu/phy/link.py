@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ziria_tpu.backend import framebatch
+from ziria_tpu.ops.viterbi import _check_radix
 from ziria_tpu.phy import channel
 from ziria_tpu.phy.wifi import rx, tx
 from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, \
@@ -156,7 +157,8 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
                   batched_tx: Optional[bool] = None,
                   fused: Optional[bool] = None,
                   viterbi_window: int = None,
-                  viterbi_metric: str = None) -> List:
+                  viterbi_metric: str = None,
+                  viterbi_radix: int = None) -> List:
     """The full N-frame mixed-rate loopback. Default: the FUSED path —
     encode → per-lane channel impairments → acquire → classify →
     gather → mixed-rate decode → batched CRC as ONE jitted device
@@ -188,6 +190,9 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
     _sym_b, l_cap = _link_buckets(psdus, rates_mbps, add_fcs,
                                   int(dly.max()))
 
+    # resolved ONCE here so the per-frame oracle, the staged path, and
+    # the fused graph's compile-cache key all see the same radix
+    viterbi_radix = _check_radix(viterbi_radix)
     if not batched_tx_enabled(batched_tx):
         # the per-frame oracle: same channel physics, one frame at a
         # time, through the per-capture receiver
@@ -200,19 +205,22 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
             results.append(rx.receive(np.asarray(cap),
                                       check_fcs=check_fcs,
                                       viterbi_window=viterbi_window,
-                                      viterbi_metric=viterbi_metric))
+                                      viterbi_metric=viterbi_metric,
+                                      viterbi_radix=viterbi_radix))
         return results
 
     geo = _LinkGeometry(psdus, rates_mbps, snr, eps, dly, add_fcs)
     if fused_link_enabled(fused):
         return _loopback_fused(geo, seed, check_fcs,
-                               viterbi_window, viterbi_metric)
+                               viterbi_window, viterbi_metric,
+                               viterbi_radix)
     return _loopback_staged(geo, seed, check_fcs, viterbi_window,
-                            viterbi_metric)
+                            viterbi_metric, viterbi_radix)
 
 
 def _loopback_staged(geo: _LinkGeometry, seed, check_fcs,
-                     viterbi_window, viterbi_metric) -> List:
+                     viterbi_window, viterbi_metric,
+                     viterbi_radix=None) -> List:
     """The staged ~5-dispatch batched loopback (the fused graph's
     bit-identical oracle): one encode_many dispatch, one impair_many
     dispatch, then receive_many_device's acquire → gather → decode
@@ -226,13 +234,15 @@ def _loopback_staged(geo: _LinkGeometry, seed, check_fcs,
         out_len=geo.l_cap)
     return framebatch.receive_many_device(
         caps, geo.n, check_fcs=check_fcs,
-        viterbi_window=viterbi_window, viterbi_metric=viterbi_metric)
+        viterbi_window=viterbi_window, viterbi_metric=viterbi_metric,
+        viterbi_radix=viterbi_radix)
 
 
 @lru_cache(maxsize=None)
 def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
                     l_cap: int, viterbi_window: int = None,
-                    viterbi_metric: str = None):
+                    viterbi_metric: str = None,
+                    viterbi_radix: int = None):
     """ONE compiled loopback link per (lane count, bit bucket, symbol
     bucket, capture bucket, decode mode): the whole TX → channel → RX
     chain — including the acquisition classify tree and the batched
@@ -274,7 +284,8 @@ def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
         #    bit count per lane are known a priori; the decoded
         #    SIGNAL only gates validity via `status`)
         clear = rx.decode_data_mixed(segs, ridx_b, ndata_b, sym_bucket,
-                                     viterbi_window, viterbi_metric)
+                                     viterbi_window, viterbi_metric,
+                                     viterbi_radix)
         # 7. batched FCS check over the decoded PSDUs
         crc_ok = rx.crc_psdu_many_graph(clear, nbits_b)
         return status, mbps_sig, len_sig, nsym_sig, clear, crc_ok
@@ -283,7 +294,8 @@ def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
 
 
 def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
-                    viterbi_window, viterbi_metric) -> List:
+                    viterbi_window, viterbi_metric,
+                    viterbi_radix=None) -> List:
     """Host wrapper of the fused graph: ONE device dispatch, then the
     per-lane RxResult assembly from the returned validity flags —
     integer reads only, exactly mirroring `_classify_acquire`'s
@@ -294,7 +306,7 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
     whole batch falls back to the staged oracle; the common case pays
     nothing for the guard."""
     fn = _jit_fused_link(geo.rows, geo.bit_b, geo.sym_b, geo.l_cap,
-                         viterbi_window, viterbi_metric)
+                         viterbi_window, viterbi_metric, viterbi_radix)
     with dispatch.timed("link.fused"):
         status, mbps_sig, len_sig, nsym_sig, clear, crc_ok = fn(
             jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
@@ -328,7 +340,8 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
             # TX sent: the staged path would decode at ITS claimed
             # geometry — replay the batch through the oracle
             return _loopback_staged(geo, seed, check_fcs,
-                                    viterbi_window, viterbi_metric)
+                                    viterbi_window, viterbi_metric,
+                                    viterbi_radix)
         if clear_np is None:
             clear_np = np.asarray(clear, np.uint8)
             crc_np = np.asarray(crc_ok) if check_fcs else None

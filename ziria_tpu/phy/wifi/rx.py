@@ -85,10 +85,13 @@ def decode_signal(frame):
     return rate_bits, length, parity_ok
 
 
-def _decode_front(frame, rate: RateParams, n_sym: int):
-    """Aligned frame -> depunctured soft LLR pairs (T, 2): channel est +
-    (n_sym x 64) matmul-FFT + equalize + pilot track + demap +
-    deinterleave + depuncture — everything before the Viterbi."""
+def _front_symbols(frame, n_sym: int):
+    """Aligned frame -> (data (n_sym, 48, 2), gain (48,)): channel est
+    + (n_sym x 64) matmul-FFT + equalize + pilot track — the shared
+    pre-demap front. Split out so the fused-demap decode can hand the
+    raw equalized subcarriers straight to the Pallas kernel
+    (ops/viterbi_pallas.viterbi_decode_batch_fused) while the XLA
+    demap path keeps consuming the identical values."""
     H = sync.estimate_channel(frame)
     syms = frame[FRAME_DATA_START: FRAME_DATA_START + 80 * n_sym]
     bins = ofdm.ofdm_demodulate(syms.reshape(n_sym, 80, 2))
@@ -96,11 +99,41 @@ def _decode_front(frame, rate: RateParams, n_sym: int):
     data, pilots = ofdm.extract_subcarriers(eq)
     data = pilot_phase_correct(data, pilots, symbol_index0=1)
     gain = cplx.cabs2(H)[jnp.asarray(ofdm.DATA_BINS)]
+    return data, gain
+
+
+def _decode_front(frame, rate: RateParams, n_sym: int):
+    """Aligned frame -> depunctured soft LLR pairs (T, 2): channel est +
+    (n_sym x 64) matmul-FFT + equalize + pilot track + demap +
+    deinterleave + depuncture — everything before the Viterbi."""
+    data, gain = _front_symbols(frame, n_sym)
     llrs = demap_mod.demap(data, rate.n_bpsc,
                            gain=jnp.broadcast_to(gain, data.shape[:-1]))
     deint = interleave.deinterleave(
         llrs.reshape(-1), rate.n_cbps, rate.n_bpsc)
     return coding.depuncture(deint, rate.coding, fill=0.0).reshape(-1, 2)
+
+
+def fused_demap_enabled(fused_demap=None) -> bool:
+    """The ONE reading of the --fused-demap / ZIRIA_FUSED_DEMAP knob
+    (default OFF — the XLA front end is the oracle): whether the
+    known-rate DATA decodes run demap + deinterleave + depuncture as
+    an in-kernel prologue of the Pallas ACS (LLRs produced and
+    consumed in VMEM, never round-tripping HBM)."""
+    if fused_demap is not None:
+        return fused_demap
+    import os
+    return os.environ.get("ZIRIA_FUSED_DEMAP", "0") == "1"
+
+
+def _fused_front_applies(viterbi_window, viterbi_metric) -> bool:
+    """Where the fused front end composes: full-frame decodes at f32
+    metrics. The windowed decode cuts LLR-domain windows the symbol
+    tile cannot express, and the quantized metrics scale by the whole
+    frame's LLR peak before the first ACS step — both fall back to
+    the (bit-identical) unfused front, documented in
+    docs/architecture.md's decode-roofline section."""
+    return not viterbi_window and (viterbi_metric or "float32") == "float32"
 
 
 def _decode_back(bits, n_psdu_bits: int):
@@ -127,7 +160,9 @@ def decode_data_static(frame, rate: RateParams, n_sym: int,
 def decode_data_batch(frames, rate: RateParams, n_sym: int,
                       n_psdu_bits: int, interpret: bool = None,
                       viterbi_window: int = None,
-                      viterbi_metric: str = None):
+                      viterbi_metric: str = None,
+                      viterbi_radix: int = None,
+                      fused_demap: bool = None):
     """Batched DATA decode: (B, frame_len, 2) -> ((B, n_psdu_bits),
     (B, 16)).
 
@@ -145,11 +180,26 @@ def decode_data_batch(frames, rate: RateParams, n_sym: int,
 
     ``viterbi_metric="int16"`` opts into the quantized saturating-
     metric kernel (the SORA int16 discipline; docs/quantized_viterbi.md
-    — the other half of the device-residency trade)."""
-    dep = jax.vmap(lambda f: _decode_front(f, rate, n_sym))(frames)
-    bits = viterbi_pallas.viterbi_decode_batch_opt(
-        dep, n_bits=n_sym * rate.n_dbps, window=viterbi_window,
-        interpret=interpret, metric_dtype=viterbi_metric)
+    — the other half of the device-residency trade); ``"int8"`` into
+    the int8+LUT kernel below it (BER-envelope accuracy).
+
+    ``viterbi_radix=4`` runs two trellis steps per ACS iteration
+    (bit-identical at f32/int16); ``fused_demap=True`` moves demap +
+    deinterleave + depuncture into the Pallas kernel (known-rate
+    surfaces only; composes with radix, falls back to the unfused
+    front under windowed/quantized modes)."""
+    if fused_demap_enabled(fused_demap) \
+            and _fused_front_applies(viterbi_window, viterbi_metric):
+        data, gain = jax.vmap(lambda f: _front_symbols(f, n_sym))(frames)
+        bits = viterbi_pallas.viterbi_decode_batch_fused(
+            data, gain, rate, n_bits=n_sym * rate.n_dbps,
+            radix=viterbi_radix, interpret=interpret)
+    else:
+        dep = jax.vmap(lambda f: _decode_front(f, rate, n_sym))(frames)
+        bits = viterbi_pallas.viterbi_decode_batch_opt(
+            dep, n_bits=n_sym * rate.n_dbps, window=viterbi_window,
+            interpret=interpret, metric_dtype=viterbi_metric,
+            radix=viterbi_radix)
     return jax.vmap(lambda b: _decode_back(b, n_psdu_bits))(bits)
 
 
@@ -180,7 +230,9 @@ class RxResult(NamedTuple):
 
 def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
                          n_bits_real, viterbi_window: int = None,
-                         viterbi_metric: str = None):
+                         viterbi_metric: str = None,
+                         viterbi_radix: int = None,
+                         fused_demap: bool = None):
     """DATA decode over a *bucketed* symbol count: `frame` is padded to
     FRAME_DATA_START + 80*n_sym_bucket samples, `n_bits_real` is the
     true data-bit count as a TRACED scalar. Returns the full descrambled
@@ -192,6 +244,32 @@ def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
     erasures — so the pad region adds no likelihood and the Viterbi path
     over the real prefix is exactly the unpadded ML path (the tail bits
     still steer it into state 0 before the pad)."""
+    if fused_demap_enabled(fused_demap) \
+            and _fused_front_applies(viterbi_window, viterbi_metric):
+        # the fused kernel applies the SAME n_bits_real erasure mask
+        # in its prologue; this single frame rides one pad-to-128 lane
+        # tile of the fused Pallas decode
+        data, gain = _front_symbols(frame, n_sym_bucket)
+        bits = viterbi_pallas.viterbi_decode_batch_fused(
+            data[None], gain[None], rate,
+            n_bits=n_sym_bucket * rate.n_dbps,
+            nbits_real=jnp.asarray(n_bits_real, jnp.int32)[None],
+            radix=viterbi_radix)[0]
+    else:
+        bits = _decode_data_bits_unfused(
+            frame, rate, n_sym_bucket, n_bits_real,
+            viterbi_window, viterbi_metric, viterbi_radix)
+    seed = scramble.recover_seed(bits[:7])
+    return scramble.descramble_bits(bits, seed)
+
+
+def _decode_data_bits_unfused(frame, rate, n_sym_bucket, n_bits_real,
+                              viterbi_window, viterbi_metric,
+                              viterbi_radix):
+    """The XLA-front-end decode body of `decode_data_bucketed`: demap
+    front end, traced erasure mask, then whichever Viterbi engine the
+    (window, metric, radix) mode selects. Raw coded bits out — the
+    caller owns the descramble tail."""
     depunct = _decode_front(frame, rate, n_sym_bucket)   # (T_b, 2)
     t = jnp.arange(depunct.shape[0])
     depunct = jnp.where((t < n_bits_real)[:, None], depunct, 0.0)
@@ -202,20 +280,33 @@ def decode_data_bucketed(frame, rate: RateParams, n_sym_bucket: int,
         # ops/viterbi_pallas.viterbi_decode_batch_windowed)
         bits = viterbi_pallas.viterbi_decode_batch_windowed(
             depunct[None], n_bits=n_sym_bucket * rate.n_dbps,
-            window=viterbi_window, metric_dtype=viterbi_metric)[0]
+            window=viterbi_window, metric_dtype=viterbi_metric,
+            radix=viterbi_radix)[0]
+    elif (viterbi._check_radix(viterbi_radix) != 2
+          or (viterbi_metric or "float32") == "int8"):
+        # the radix knob (and the int8 kernel) live in the Pallas
+        # batch decode; ride it as a single-lane batch so the bucketed
+        # per-capture path inherits the faster core too
+        bits = viterbi_pallas.viterbi_decode_batch(
+            depunct[None], n_bits=n_sym_bucket * rate.n_dbps,
+            metric_dtype=viterbi_metric, radix=viterbi_radix)[0]
     else:
         bits = viterbi.viterbi_decode(
             depunct, n_bits=n_sym_bucket * rate.n_dbps,
             metric_dtype=viterbi_metric)
-    seed = scramble.recover_seed(bits[:7])
-    return scramble.descramble_bits(bits, seed)
+    return bits
 
 
 @lru_cache(maxsize=None)
 def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
                               fxp: bool = False,
                               viterbi_window: int = None,
-                              viterbi_metric: str = None):
+                              viterbi_metric: str = None,
+                              viterbi_radix: int = None,
+                              fused_demap: bool = None):
+    """Callers pass RESOLVED radix/fused values (never None-meaning-
+    env): the decode mode is part of the compile-cache key, so an
+    in-process env change must re-trace (ADVICE r5 #1 discipline)."""
     rate = RATES[rate_mbps]
 
     if fxp:
@@ -228,7 +319,8 @@ def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
         def f(frame, n_bits_real):
             return decode_data_bucketed(frame, rate, n_sym_bucket,
                                         n_bits_real, viterbi_window,
-                                        viterbi_metric)
+                                        viterbi_metric, viterbi_radix,
+                                        fused_demap)
 
     return jax.jit(f)
 
@@ -248,6 +340,7 @@ def _sym_bucket(n_sym: int) -> int:
 def decode_data_mixed(frames, rate_idx, n_bits_real, n_sym_bucket: int,
                       viterbi_window: int = None,
                       viterbi_metric: str = None,
+                      viterbi_radix: int = None,
                       interpret: bool = None):
     """Mixed-rate batched DATA decode in ONE device dispatch — the
     compiled-program analogue of Ziria's in-language rate dispatch
@@ -278,6 +371,13 @@ def decode_data_mixed(frames, rate_idx, n_bits_real, n_sym_bucket: int,
     DATA stage drops from O(rates x log lengths) to O(log lengths),
     and a mixed-rate batch costs ONE device call instead of one per
     rate group.
+
+    ``viterbi_radix``/``viterbi_metric`` reach the shared Pallas ACS,
+    so every mixed surface (receive_many, the streaming receiver, the
+    fused link) inherits the faster core. The fused-demap front end
+    does NOT apply here by design: its slot tables are rate-static,
+    and per-lane tables would fragment the one rate-agnostic Viterbi
+    this dispatch exists to share — the cheap XLA front end stays.
     """
     t_max = n_sym_bucket * MAX_DBPS
 
@@ -299,7 +399,7 @@ def decode_data_mixed(frames, rate_idx, n_bits_real, n_sym_bucket: int,
                     dep, 0.0)
     bits = viterbi_pallas.viterbi_decode_batch_opt(
         dep, window=viterbi_window, metric_dtype=viterbi_metric,
-        interpret=interpret)
+        radix=viterbi_radix, interpret=interpret)
 
     def _descramble(b):
         seed = scramble.recover_seed(b[:7])
@@ -332,15 +432,17 @@ def _jit_crc_many():
 
 @lru_cache(maxsize=None)
 def _jit_decode_data_mixed(n_sym_bucket: int, viterbi_window: int = None,
-                           viterbi_metric: str = None):
+                           viterbi_metric: str = None,
+                           viterbi_radix: int = None):
     """ONE jit per (symbol bucket, decode mode) serving ALL rates —
-    the decode-mode knobs are part of the cache key, so an in-process
-    change can never silently reuse the other mode's trace (ADVICE r5
-    #1 discipline)."""
+    the decode-mode knobs (window, metric, radix) are part of the
+    cache key, so an in-process change can never silently reuse the
+    other mode's trace (ADVICE r5 #1 discipline; callers pass a
+    RESOLVED radix, never None-meaning-env)."""
     def f(frames, rate_idx, n_bits_real):
         return decode_data_mixed(frames, rate_idx, n_bits_real,
                                  n_sym_bucket, viterbi_window,
-                                 viterbi_metric)
+                                 viterbi_metric, viterbi_radix)
     return jax.jit(f)
 
 
@@ -823,16 +925,20 @@ def _jit_stream_chunk(k: int, win_len: int, n_sym_bucket: int,
 
 @lru_cache(maxsize=None)
 def _jit_stream_decode(n_sym_bucket: int, viterbi_window: int = None,
-                       viterbi_metric: str = None):
+                       viterbi_metric: str = None,
+                       viterbi_radix: int = None):
     """Dispatch 2 of the streaming chunk: row-select the decodable
     lanes INSIDE the jit (the segment batch never re-crosses the host
     link), the one-`lax.switch` mixed-rate decode at the stream's
     fixed symbol bucket, and the vmapped masked-CRC check. The CRC
     flags are always computed (noise next to the Viterbi), so one
-    compile serves both `check_fcs` modes — the fused-link rule."""
+    compile serves both `check_fcs` modes — the fused-link rule. The
+    decode-mode knobs are cache keys (resolved radix, like every jit
+    factory here)."""
     def f(segs, rows, ridx, nbits, npsdu):
         clear = decode_data_mixed(segs[rows], ridx, nbits, n_sym_bucket,
-                                  viterbi_window, viterbi_metric)
+                                  viterbi_window, viterbi_metric,
+                                  viterbi_radix)
         return clear, crc_psdu_many_graph(clear, npsdu)
     return jax.jit(f)
 
@@ -840,7 +946,9 @@ def _jit_stream_decode(n_sym_bucket: int, viterbi_window: int = None,
 def receive(samples, check_fcs: bool = False,
             max_samples: int = 1 << 16, fxp: bool = False,
             viterbi_window: int = None,
-            viterbi_metric: str = None) -> RxResult:
+            viterbi_metric: str = None,
+            viterbi_radix: int = None,
+            fused_demap: bool = None) -> RxResult:
     """Host-side receiver driver: detect, align, CFO-correct, parse
     SIGNAL, dispatch the per-rate decoder — the jit analogue of the
     reference's header-driven rate dispatch. The data decode compiles
@@ -859,8 +967,11 @@ def receive(samples, check_fcs: bool = False,
     viterbi_window opts the (float) DATA decode into the sliding-
     window parallel Viterbi — same result at operating SNR, ~T/window
     less sequential trellis depth on the chip; viterbi_metric="int16"
-    opts it into the quantized saturating-metric kernel (both ignored
-    under fxp, whose decode keeps the exact scan).
+    opts it into the quantized saturating-metric kernel and "int8"
+    into the int8+LUT kernel below it; viterbi_radix=4 runs two
+    trellis steps per ACS iteration and fused_demap=True moves the
+    demap/deinterleave/depuncture front end into the decode kernel
+    (all ignored under fxp, whose decode keeps the exact scan).
     """
     res, acq = _acquire_frame(samples, max_samples)
     if acq is None:
@@ -879,9 +990,12 @@ def receive(samples, check_fcs: bool = False,
         rms = float(np.sqrt(np.mean(acq.frame_np[:320].astype(np.float64)
                                     ** 2) * 2.0))
         seg = rx_fxp.quantize_frame(np.asarray(seg) / max(rms, 1e-12))
-    dec = _jit_decode_data_bucketed(acq.rate_mbps, n_sym_b, fxp,
-                                    None if fxp else viterbi_window,
-                                    None if fxp else viterbi_metric)
+    dec = _jit_decode_data_bucketed(
+        acq.rate_mbps, n_sym_b, fxp,
+        None if fxp else viterbi_window,
+        None if fxp else viterbi_metric,
+        None if fxp else viterbi._check_radix(viterbi_radix),
+        None if fxp else fused_demap_enabled(fused_demap))
     from ziria_tpu.utils import dispatch
     with dispatch.timed("rx.decode_bucketed"):
         clear = np.asarray(

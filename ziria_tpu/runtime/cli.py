@@ -238,14 +238,45 @@ def build_parser() -> argparse.ArgumentParser:
     # tests/test_viterbi_int16.py::test_cli_choices_mirror_metric_dtypes)
     # — not imported here so --help stays cheap
     p.add_argument("--viterbi-metric", default=None,
-                   choices=["float32", "int16"],
+                   choices=["float32", "int16", "int8"],
                    help="path-metric dtype for every staged "
                         "viterbi_soft ext: int16 runs the quantized "
                         "saturating-metric Pallas kernel (the SORA "
                         "trade — half the LLR stream and metric "
-                        "footprint; docs/quantized_viterbi.md), "
-                        "float32 the exact oracle (default); also via "
-                        "ZIRIA_VITERBI_METRIC")
+                        "footprint; docs/quantized_viterbi.md), int8 "
+                        "the 4-bit-soft LUT-branch-metric kernel "
+                        "below it (half the resident metric state "
+                        "again; BER-envelope accuracy, not bit "
+                        "identity), float32 the exact oracle "
+                        "(default); also via ZIRIA_VITERBI_METRIC")
+    # choices mirror ops.viterbi.RADIXES (same pinned-mirror rule)
+    p.add_argument("--viterbi-radix", type=int, default=None,
+                   choices=[2, 4],
+                   help="trellis steps per Pallas ACS iteration for "
+                        "every staged viterbi_soft ext and library "
+                        "decode surface: 4 collapses butterfly pairs "
+                        "into one 4-way compare — half the sequential "
+                        "dependency chain of the decode core's "
+                        "hottest kernel, bit-identical to 2 (the "
+                        "default/oracle) at float32 and int16; also "
+                        "via ZIRIA_VITERBI_RADIX")
+    p.add_argument("--fused-demap", dest="fused_demap",
+                   action="store_true", default=None,
+                   help="run demap + deinterleave + depuncture as an "
+                        "in-kernel prologue of the Pallas Viterbi on "
+                        "the known-rate DATA decodes (receive / "
+                        "decode_data_batch): LLRs are produced and "
+                        "consumed in VMEM and never round-trip HBM "
+                        "between the front end and the ACS "
+                        "(docs/architecture.md decode-roofline "
+                        "section; the mixed-rate switch decode keeps "
+                        "the XLA front end). Also via "
+                        "ZIRIA_FUSED_DEMAP=1")
+    p.add_argument("--no-fused-demap", dest="fused_demap",
+                   action="store_false",
+                   help="force the XLA front end (the fused "
+                        "prologue's bit-identical oracle; the "
+                        "default); also via ZIRIA_FUSED_DEMAP=0")
     p.add_argument("--batched-acquire", dest="batched_acquire",
                    action="store_true", default=None,
                    help="one-dispatch batched acquisition for the "
@@ -655,6 +686,13 @@ def main(argv=None) -> int:
         overrides["ZIRIA_VITERBI_WINDOW"] = str(args.viterbi_window)
     if args.viterbi_metric is not None:
         overrides["ZIRIA_VITERBI_METRIC"] = args.viterbi_metric
+    if args.viterbi_radix is not None:
+        # --viterbi-radix=2 force-disables an exported env value, the
+        # same force-off semantics as --viterbi-metric=float32
+        overrides["ZIRIA_VITERBI_RADIX"] = str(args.viterbi_radix)
+    if args.fused_demap is not None:
+        overrides["ZIRIA_FUSED_DEMAP"] = \
+            "1" if args.fused_demap else "0"
     if args.batched_acquire is not None:
         # receive_many reads this at call time; scoping the write
         # keeps in-process callers from inheriting the flag, same as
