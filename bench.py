@@ -1262,19 +1262,44 @@ def _child_main(run_id):
     # ISSUE 5 tentpole evidence: the streaming receiver's O(chunks)
     # dispatch count vs the per-capture path's O(frames) over the same
     # multi-frame stream, identity-gated, with the double-buffer
-    # in-flight gauge. Same resumable never-fatal stage discipline.
+    # in-flight gauge. Since ISSUE 7 the stage also reports per-chunk
+    # p50/p99 latency from the telemetry histogram layer and leaves a
+    # Chrome trace (BENCH_TRACE_streaming.json) plus its
+    # tools/trace_report.py summary next to the JSON artifacts, so
+    # every bench run ships a readable timeline of the streaming loop.
+    # Same resumable never-fatal stage discipline.
     def _streaming_rx_stage():
         if time.time() - t0 > 0.97 * budget:
             raise TimeoutError("skipped: child time budget")
         cpu = os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+        trace_path = os.path.join(REPO, "BENCH_TRACE_streaming.json")
         ev = _load_rx_dispatch_bench().streaming_stats(
-            n_frames=8 if cpu else 16)
+            n_frames=8 if cpu else 16, trace_path=trace_path)
+        chunk_lat = ev.get("latency_ms_streaming", {}).get(
+            "rx.stream_chunk", {})
         note(f"streaming rx: {ev['frames']} frames / "
              f"{ev['chunks']} chunks, "
              f"{ev['dispatches_percapture']} dispatches -> "
              f"{ev['dispatches_streaming']} "
              f"({ev['sps_streaming']:.0f} sps, in-flight "
-             f"{ev['max_in_flight']})")
+             f"{ev['max_in_flight']}, chunk p50/p99 "
+             f"{chunk_lat.get('p50', '?')}/{chunk_lat.get('p99', '?')}"
+             f" ms)")
+        # trace summary smoke: the trace the stage just wrote must
+        # parse; its table rides the artifact so the timeline is
+        # readable without loading Perfetto
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "trace_report", os.path.join(REPO, "tools",
+                                             "trace_report.py"))
+            tr = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(tr)
+            _summary, table = tr.summarize_file(trace_path)
+            ev["trace_summary"] = table
+            note("trace summary:\n" + table)
+        except Exception as e:          # summary is evidence, not a gate
+            ev["trace_summary_error"] = repr(e)
         part("streaming_rx", **ev)
         return ev
 

@@ -72,6 +72,11 @@ smoke or a manual chip window:
   per-capture path over the same detected windows — identity-gated
   frame for frame (results AND starts vs ground truth), samples/s,
   dispatch counts, and the double-buffer in-flight depth gauge.
+  Since ISSUE 7, ``streaming_stats`` and ``fused_link_stats`` also
+  report per-site latency DISTRIBUTIONS (p50/p90/p99/max ms) off the
+  utils/telemetry histogram layer (``latency_ms_*`` blocks), and
+  ``streaming_stats(trace_path=...)`` leaves a Chrome trace of one
+  streaming pass for tools/trace_report.py.
 
 Standalone: ``ZIRIA_TOOL_ALLOW_CPU=1 python tools/rx_dispatch_bench.py``
 runs all at shrunk sizes on CPU (results labelled platform=cpu,
@@ -109,6 +114,22 @@ def _timed(fn, *args, reps=1, tries=3):
         _fence(o)
         best = min(best, (time.perf_counter() - t0) / reps)
     return best
+
+
+def _latency_block(reg):
+    """Per-site latency summaries (ms) off a telemetry registry's
+    dispatch histograms: {site: {count, mean, p50, p90, p99, max}} —
+    distribution-level numbers from the histogram layer, NOT summed
+    means (p50/p99 are the power-of-two bucket quantile bounds, max
+    and mean exact)."""
+    from ziria_tpu.utils import telemetry
+
+    out = {}
+    for (name, labels), m in reg.metrics():
+        if name == telemetry.DISPATCH_HISTOGRAM:
+            out[dict(labels).get("site", "")] = m.summary(
+                scale=1e3, ndigits=4)
+    return out
 
 
 def quantized_sweep(B=128, n_bytes=1000, rate_mbps=54,
@@ -366,15 +387,22 @@ def fused_link_stats(n_frames=8, n_bytes=100, snr_db=28.0):
     kw = dict(snr_db=snr_db, cfo=cfo, delay=delay, seed=6,
               add_fcs=True, check_fcs=True)
 
-    with count_dispatches() as d_st:
-        res_s = link.loopback_many(psdus, mbps, fused=False, **kw)
-    t_st = _timed(lambda: link.loopback_many(
-        psdus, mbps, fused=False, **kw))
+    from ziria_tpu.utils import telemetry
 
-    with count_dispatches() as d_fu:
-        res_f = link.loopback_many(psdus, mbps, fused=True, **kw)
-    t_fu = _timed(lambda: link.loopback_many(
-        psdus, mbps, fused=True, **kw))
+    # collect() around BOTH the counted run and the timed repeats so
+    # the per-site latency histograms hold enough samples for the
+    # p50/p99 bounds to mean something
+    with telemetry.collect() as reg_st:
+        with count_dispatches() as d_st:
+            res_s = link.loopback_many(psdus, mbps, fused=False, **kw)
+        t_st = _timed(lambda: link.loopback_many(
+            psdus, mbps, fused=False, **kw))
+
+    with telemetry.collect() as reg_fu:
+        with count_dispatches() as d_fu:
+            res_f = link.loopback_many(psdus, mbps, fused=True, **kw)
+        t_fu = _timed(lambda: link.loopback_many(
+            psdus, mbps, fused=True, **kw))
 
     assert all(a.ok == b.ok and a.crc_ok == b.crc_ok
                and a.rate_mbps == b.rate_mbps
@@ -392,6 +420,11 @@ def fused_link_stats(n_frames=8, n_bytes=100, snr_db=28.0):
         "dispatch_breakdown_staged": dict(d_st.counts),
         "dispatch_times_ms_staged": d_st.times_ms(),
         "dispatch_times_ms_fused": d_fu.times_ms(),
+        # per-dispatch latency DISTRIBUTIONS (telemetry histograms):
+        # the fused block's "link.fused" row is the per-dispatch
+        # p50/p99 the serving work asks for
+        "latency_ms_staged": _latency_block(reg_st),
+        "latency_ms_fused": _latency_block(reg_fu),
         "t_staged_s": round(t_st, 4),
         "t_fused_s": round(t_fu, 4),
         "fps_staged": round(n_frames / t_st, 1),
@@ -452,7 +485,8 @@ def ber_sweep_stats(n_frames=16, n_bytes=50, rates=(6, 24, 54),
 
 
 def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
-                    chunk_len=4096, frame_len=1024, k=8):
+                    chunk_len=4096, frame_len=1024, k=8,
+                    trace_path=None):
     """An N-frame continuous stream through the chunked streaming
     receiver vs the per-capture oracle over the same detected windows:
     dispatch counts (instrumented counter — the O(chunks) vs O(frames)
@@ -460,10 +494,16 @@ def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
     a frame-for-frame identity gate (every emitted start must hit the
     synthesizer's ground truth; every RxResult must be bit-identical
     to the oracle's). ``check_fcs=True`` so the masked-CRC tail rides
-    the measurement. Returns a flat dict."""
+    the measurement. Per-chunk/per-dispatch latency lands as p50/p99
+    blocks from the telemetry histogram layer (``latency_ms_*``), and
+    ``trace_path`` — when given — additionally records one streaming
+    pass as a Chrome trace there (chunk/decode spans, in-flight and
+    carry-depth counter tracks, compile events; summarize with
+    tools/trace_report.py). Returns a flat dict."""
     from ziria_tpu.backend import framebatch
     from ziria_tpu.phy import link
     from ziria_tpu.phy.wifi.params import RATES
+    from ziria_tpu.utils import telemetry
     from ziria_tpu.utils.dispatch import count_dispatches
 
     rng = np.random.default_rng(17)
@@ -476,17 +516,27 @@ def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
     kw = dict(chunk_len=chunk_len, frame_len=frame_len,
               max_frames_per_chunk=k, check_fcs=True)
 
-    with count_dispatches() as d_pc:
-        res_p, st_p = framebatch.receive_stream(stream, streaming=False,
-                                                **kw)
-    t_pc = _timed(lambda: framebatch.receive_stream(
-        stream, streaming=False, **kw))
+    # collect() spans the counted run AND the timed repeats: the
+    # per-chunk latency histograms see chunks x repeats samples
+    with telemetry.collect() as reg_pc:
+        with count_dispatches() as d_pc:
+            res_p, st_p = framebatch.receive_stream(
+                stream, streaming=False, **kw)
+        t_pc = _timed(lambda: framebatch.receive_stream(
+            stream, streaming=False, **kw))
 
-    with count_dispatches() as d_st:
-        res_s, st_s = framebatch.receive_stream(stream, streaming=True,
-                                                **kw)
-    t_st = _timed(lambda: framebatch.receive_stream(
-        stream, streaming=True, **kw))
+    with telemetry.collect() as reg_st:
+        with count_dispatches() as d_st:
+            res_s, st_s = framebatch.receive_stream(
+                stream, streaming=True, **kw)
+        t_st = _timed(lambda: framebatch.receive_stream(
+            stream, streaming=True, **kw))
+
+    if trace_path:
+        # one warm streaming pass under an exporting trace: spans +
+        # counter tracks + (warm, so few) compile events
+        with telemetry.tracing(trace_path):
+            framebatch.receive_stream(stream, streaming=True, **kw)
 
     assert [f.start for f in res_s] == list(starts), \
         "streaming starts diverged from the synthesizer ground truth"
@@ -514,6 +564,12 @@ def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
         "dispatch_breakdown_streaming": dict(d_st.counts),
         "dispatch_times_ms_streaming": d_st.times_ms(),
         "dispatch_times_ms_percapture": d_pc.times_ms(),
+        # distribution-level per-site latency (telemetry histograms):
+        # "rx.stream_chunk" is the per-chunk p50/p99 the serving
+        # harness will report against SLOs — not a summed mean
+        "latency_ms_streaming": _latency_block(reg_st),
+        "latency_ms_percapture": _latency_block(reg_pc),
+        "trace_path": trace_path,
         "max_in_flight": st_s.max_in_flight,
         "overflow_chunks": st_s.overflow_chunks,
         "t_percapture_s": round(t_pc, 4),

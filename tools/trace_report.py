@@ -1,0 +1,154 @@
+"""Summarize a telemetry Chrome trace (utils/telemetry.Trace.export)
+as a per-label latency table.
+
+A trace file answers "what happened when" in Perfetto; this tool
+answers the quicker question — "where did the time go, and was any of
+it compiles?" — without leaving the terminal:
+
+    python tools/trace_report.py TRACE.json
+
+prints one row per span label (count, exact p50/p99/max milliseconds
+computed from the raw event durations — the trace has every duration,
+so no bucket bounds needed here — and total ms), spans and compile
+events in separate sections, plus the counter tracks' last/max levels.
+`bench.py`'s streaming stage runs :func:`summarize_file` on the trace
+it exports so every bench run leaves a readable summary next to its
+JSON artifacts; `tests/test_telemetry.py` pins the parse against
+traces the layer actually writes.
+"""
+
+import json
+import sys
+
+
+def load(path):
+    """The trace's event list. Accepts both the exported object form
+    ({"traceEvents": [...]}) and a bare JSON array of events."""
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, dict):
+        return obj.get("traceEvents", [])
+    return obj
+
+
+def _rank(sorted_vals, q):
+    """Exact nearest-rank q-quantile of an ascending list."""
+    import math
+    n = len(sorted_vals)
+    return sorted_vals[min(n - 1, max(1, math.ceil(q * n)) - 1)]
+
+
+def summarize(events):
+    """Per-label rollup of a trace-event list. Returns a dict:
+
+    - ``spans``: {label: {count, p50_ms, p99_ms, max_ms, total_ms}}
+      over complete ("X") events NOT in the compile category;
+    - ``compiles``: the same rollup over compile-category complete
+      events, plus {label: count} instant compile markers (cache
+      growth deltas) under ``compile_markers``;
+    - ``counters``: {name: {samples, last, max}} from counter tracks.
+    """
+    spans = {}
+    compiles = {}
+    markers = {}
+    counters = {}
+    for ev in events:
+        ph = ev.get("ph")
+        name = ev.get("name", "?")
+        cat = ev.get("cat", "")
+        if ph == "X":
+            (compiles if cat == "compile" else spans).setdefault(
+                name, []).append(float(ev.get("dur", 0.0)) / 1e3)
+        elif ph == "i" and cat == "compile":
+            # cache-growth markers carry the entry delta in args
+            # (new_entries from dispatch.cache_growth, count from a
+            # bare record_compile); an unweighted marker counts as one
+            a = ev.get("args", {})
+            d = a.get("new_entries", a.get("count", 1))
+            markers[name] = markers.get(name, 0) + int(d)
+        elif ph == "C":
+            v = ev.get("args", {}).get("value")
+            if v is None:           # foreign counter form: first arg
+                a = ev.get("args", {})
+                v = next(iter(a.values()), None) if a else None
+            if v is not None:
+                c = counters.setdefault(name,
+                                        {"samples": 0, "last": None,
+                                         "max": float("-inf")})
+                c["samples"] += 1
+                c["last"] = float(v)
+                c["max"] = max(c["max"], float(v))
+
+    def rollup(durs_by_label):
+        out = {}
+        for label, ds in sorted(durs_by_label.items()):
+            ds.sort()
+            out[label] = {
+                "count": len(ds),
+                "p50_ms": round(_rank(ds, 0.50), 3),
+                "p99_ms": round(_rank(ds, 0.99), 3),
+                "max_ms": round(ds[-1], 3),
+                "total_ms": round(sum(ds), 3),
+            }
+        return out
+
+    return {"spans": rollup(spans), "compiles": rollup(compiles),
+            "compile_markers": markers, "counters": counters}
+
+
+def format_table(summary):
+    """The human-readable report: one aligned table per section."""
+    lines = []
+
+    def section(title, rows):
+        if not rows:
+            return
+        lines.append(title)
+        w = max(len(k) for k in rows)
+        lines.append(f"  {'label':<{w}} {'count':>6} {'p50 ms':>9} "
+                     f"{'p99 ms':>9} {'max ms':>9} {'total ms':>10}")
+        for label, r in rows.items():
+            lines.append(
+                f"  {label:<{w}} {r['count']:>6} {r['p50_ms']:>9.3f} "
+                f"{r['p99_ms']:>9.3f} {r['max_ms']:>9.3f} "
+                f"{r['total_ms']:>10.3f}")
+
+    section("spans:", summary["spans"])
+    section("compile events:", summary["compiles"])
+    if summary["compile_markers"]:
+        lines.append("compile markers (cache growth):")
+        for name, n in sorted(summary["compile_markers"].items()):
+            lines.append(f"  {name}: {n}")
+    if summary["counters"]:
+        lines.append("counter tracks:")
+        for name, c in sorted(summary["counters"].items()):
+            lines.append(f"  {name}: {c['samples']} samples, "
+                         f"last={c['last']:g} max={c['max']:g}")
+    return "\n".join(lines)
+
+
+def summarize_file(path):
+    """(summary dict, formatted table) for a trace file — the one-call
+    surface bench.py's streaming stage uses."""
+    s = summarize(load(path))
+    return s, format_table(s)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python tools/trace_report.py TRACE.json",
+              file=sys.stderr)
+        return 2
+    try:
+        _s, table = summarize_file(argv[0])
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read trace {argv[0]!r}: {e}",
+              file=sys.stderr)
+        return 1
+    print(table or "(empty trace)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -506,12 +506,20 @@ class StreamReceiver:
         arr = np.asarray(samples, np.float32)
         if arr.size:
             self._tail = np.concatenate([self._tail, arr], axis=0)
+        from ziria_tpu.utils import dispatch
+
         out: List[StreamFrame] = []
         while self._tail.shape[0] >= self.chunk_len:
             out += self._launch(self._tail[:self.chunk_len],
                                 self.chunk_len, self.stride)
             self._tail = self._tail[self.stride:]
             self._offset += self.stride
+            # carry depth after each chunk consumption: with telemetry
+            # active this is a plottable counter track (does the push
+            # cadence keep up with the chunk stride, or does the tail
+            # grow?); a plain high-water mark under count_dispatches
+            dispatch.record_gauge("rx.stream_carry_depth",
+                                  self._tail.shape[0])
         return out
 
     def flush(self) -> List[StreamFrame]:
@@ -615,6 +623,7 @@ class StreamReceiver:
                     viterbi_metric=self.viterbi_metric,
                     viterbi_radix=self.viterbi_radix)))
             self._emitted += len(out)
+            self._note_emitted(len(out))
             return out
 
         emit = {}
@@ -658,7 +667,16 @@ class StreamReceiver:
                     bool(crc[i]) if self.check_fcs else None)
         out = [StreamFrame(s, emit[s]) for s in sorted(emit)]
         self._emitted += len(out)
+        self._note_emitted(len(out))
         return out
+
+    def _note_emitted(self, k: int) -> None:
+        """Frames-emitted counter into the telemetry layer (registry
+        increment + cumulative counter track in active traces). Free
+        when nothing is collecting."""
+        if k:
+            from ziria_tpu.utils import telemetry
+            telemetry.count("rx.stream_frames", k, total=self._emitted)
 
 
 def receive_stream(samples, chunk_len: int = 1 << 13,

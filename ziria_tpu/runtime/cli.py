@@ -213,6 +213,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-trace", metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR "
                         "(view with TensorBoard / xprof)")
+    p.add_argument("--trace", metavar="PATH",
+                   help="write a Chrome trace-event JSON of this "
+                        "invocation's instrumented host spans — every "
+                        "dispatch site, gauge counter track, and "
+                        "compile event — to PATH "
+                        "(utils/telemetry; load in Perfetto / "
+                        "chrome://tracing or summarize with "
+                        "tools/trace_report.py); also via ZIRIA_TRACE")
+    p.add_argument("--metrics-dump", action="store_true",
+                   help="print a Prometheus-style text exposition of "
+                        "the invocation's metrics registry — dispatch "
+                        "counters, per-site latency histograms "
+                        "(power-of-two buckets, p50/p99 bounds), "
+                        "gauges — to stderr at exit (utils/telemetry; "
+                        "docs/observability.md)")
     p.add_argument("--state-in",
                    help="resume stream state from this checkpoint "
                         "(runtime/state.py; jit backend)")
@@ -714,6 +729,11 @@ def main(argv=None) -> int:
         # (the chunked streaming receiver vs its per-capture oracle)
         overrides["ZIRIA_STREAMING_RX"] = \
             "1" if args.streaming_rx else "0"
+    if args.trace:
+        # telemetry.env_trace_path reads this inside _main_run; the
+        # scoped write keeps in-process callers from inheriting an
+        # always-on trace, same as every knob above
+        overrides["ZIRIA_TRACE"] = args.trace
     if not overrides:
         return _main_run(args)
     prev = {k: os.environ.get(k) for k in overrides}
@@ -729,6 +749,41 @@ def main(argv=None) -> int:
 
 
 def _main_run(args) -> int:
+    """The telemetry shell around every command path: when --trace /
+    ZIRIA_TRACE names a path, the whole run is recorded as a Chrome
+    trace and exported there (even on failure — a crashed run's trace
+    is the one you want most); --metrics-dump collects the run's
+    metrics registry and prints its Prometheus-style exposition to
+    stderr at exit."""
+    from ziria_tpu.utils import telemetry
+
+    tpath = telemetry.env_trace_path()
+    if not tpath and not args.metrics_dump:
+        return _run_cmd(args)
+    import contextlib
+    reg = None
+    try:
+        with contextlib.ExitStack() as stack:
+            if tpath:
+                stack.enter_context(telemetry.tracing(tpath))
+            if args.metrics_dump:
+                reg = stack.enter_context(telemetry.collect())
+            return _run_cmd(args)
+    finally:
+        # the crashed run's telemetry is the telemetry you want most:
+        # tracing() exports in its own finally, and the exposition /
+        # hint print here so ^C or a failing command still reports
+        if tpath:
+            print(f"telemetry trace written to {tpath} "
+                  f"(summarize: python tools/trace_report.py {tpath})",
+                  file=sys.stderr)
+        if reg is not None:
+            print("metrics exposition (utils/telemetry):",
+                  file=sys.stderr)
+            print(reg.exposition(), file=sys.stderr, end="")
+
+
+def _run_cmd(args) -> int:
     if args.scan:
         return _run_scan(args)
 

@@ -30,6 +30,20 @@ both a first-class seam:
 Both are reentrant and thread-safe: nested/overlapping counters each
 see every event recorded while they are active (frame threads under
 ``framebatch.run_many`` all report into the same active counters).
+Each :class:`DispatchCount` owns its OWN lock — concurrent
+instrumented sites (the double-buffered streaming loop, ``run_many``
+frame threads) update counters without contending on one global
+mutex; the module lock only guards (de)activation.
+
+The sites are also the emission points of the runtime telemetry layer
+(:mod:`ziria_tpu.utils.telemetry`): when a trace or metrics registry
+is active, :func:`timed` records a span plus a latency-histogram
+observation, :func:`record` a labelled counter increment, and
+:func:`record_gauge` a time-series gauge sample and a trace
+counter-track point — so every instrumented surface gets
+distribution-level (p50/p99) latency and plottable gauge levels with
+no changes at the call sites. All of it stays free when nothing is
+active (the same one-truthiness-check fast path).
 
 The module also owns the *dispatch geometry* helpers every batched
 path shares (:func:`pow2_ceil`, :func:`pow2_bucket`,
@@ -49,8 +63,16 @@ from collections import Counter
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
-_LOCK = threading.Lock()
+from ziria_tpu.utils import telemetry as _tm
+
+_LOCK = threading.Lock()          # guards _ACTIVE mutation only
 _ACTIVE: List["DispatchCount"] = []
+
+
+def _idle() -> bool:
+    """True when no counter, trace, or registry is collecting — the
+    one check every emitter's disabled fast path takes."""
+    return not (_ACTIVE or _tm._TRACES or _tm._REGISTRIES)
 
 
 # ------------------------------------------------------ dispatch geometry
@@ -87,12 +109,26 @@ class DispatchCount:
     :func:`record` contribute counts only); ``gauges`` the per-label
     high-water marks from :func:`record_gauge` sites (e.g. the
     streaming receiver's in-flight chunk depth — a *level*, not an
-    event count, so it maxes rather than sums)."""
+    event count, so it maxes rather than sums). Updates go through the
+    instance's OWN lock, so two counters active at once (or many
+    threads reporting into one) never serialize on a shared mutex."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.counts: Counter = Counter()
         self.times: Counter = Counter()      # label -> wall seconds
         self.gauges: Dict[str, float] = {}   # label -> max level seen
+
+    def _add(self, label: str, n: int, seconds: Optional[float]) -> None:
+        with self._lock:
+            self.counts[label] += n
+            if seconds is not None:
+                self.times[label] += seconds
+
+    def _gauge(self, label: str, value: float) -> None:
+        with self._lock:
+            if value > self.gauges.get(label, float("-inf")):
+                self.gauges[label] = value
 
     @property
     def total(self) -> int:
@@ -117,18 +153,22 @@ def record(label: str = "dispatch", n: int = 1,
            seconds: Optional[float] = None) -> None:
     """Report ``n`` device dispatches at an instrumented call site,
     optionally with the wall time the call took (``seconds``; the
-    :func:`timed` wrapper measures and passes it).
+    :func:`timed` wrapper measures and passes it). Also increments the
+    per-site dispatch counter (and, when timed, the latency histogram)
+    of every active telemetry registry.
 
-    Free when no counter is active (one lock-free len check), so the
-    hot paths carry their instrumentation permanently.
+    Free when nothing is collecting (one truthiness check), so the
+    hot paths carry their instrumentation permanently. Active counters
+    update under their own per-instance locks — no shared mutex on
+    the instrumented fast path (``tuple(_ACTIVE)`` is an atomic
+    snapshot under the GIL).
     """
-    if not _ACTIVE:
+    if _idle():
         return
-    with _LOCK:
-        for c in _ACTIVE:
-            c.counts[label] += n
-            if seconds is not None:
-                c.times[label] += seconds
+    for c in tuple(_ACTIVE):
+        c._add(label, n, seconds)
+    if _tm._REGISTRIES:
+        _tm.dispatch_event(label, n, seconds)
 
 
 def record_gauge(label: str, value: float) -> None:
@@ -137,13 +177,16 @@ def record_gauge(label: str, value: float) -> None:
     keep the maximum level observed, so ``d.gauges["..."]`` after a
     :func:`count_dispatches` block is the high-water mark — the number
     that shows whether double-buffered overlap actually overlapped.
-    Free when no counter is active (one lock-free len check)."""
-    if not _ACTIVE:
+    Active telemetry sinks additionally get EVERY sample: a
+    time-series point per registry and a counter-track event per trace
+    — the level over time, so a chart shows *how long* the level was
+    sustained, not just that it was reached.
+    Free when nothing is collecting (one truthiness check)."""
+    if _idle():
         return
-    with _LOCK:
-        for c in _ACTIVE:
-            if value > c.gauges.get(label, float("-inf")):
-                c.gauges[label] = value
+    for c in tuple(_ACTIVE):
+        c._gauge(label, value)
+    _tm.gauge_sample(label, value)
 
 
 @contextmanager
@@ -152,16 +195,19 @@ def timed(label: str = "dispatch"):
     site plus the wall time of the block. The preferred form for
     instrumented call sites: dispatch *time*, not just count, becomes
     observable per stage (`tools/rx_dispatch_bench.py` stats blocks
-    report both). Near-free when no counter is active (one clock pair
-    and a len check)."""
-    if not _ACTIVE:
+    report both). With telemetry active the block is additionally a
+    trace span and a latency-histogram observation — p50/p99 per site
+    for free. Near-free when nothing is collecting (one truthiness
+    check)."""
+    if _idle():
         yield
         return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        record(label, seconds=time.perf_counter() - t0)
+    with _tm.span(label):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            record(label, seconds=time.perf_counter() - t0)
 
 
 @contextmanager
@@ -180,7 +226,11 @@ def count_dispatches():
 
 
 class CacheGrowth:
-    """Per-cache ``currsize`` deltas captured on context exit."""
+    """Per-cache ``currsize`` deltas captured on context exit. With
+    telemetry active, nonzero deltas are reported as compile events
+    (`telemetry.record_compile`) — fresh jit-factory entries show up
+    in the trace as compile markers instead of masquerading as slow
+    dispatches."""
 
     def __init__(self, caches: Tuple) -> None:
         self._caches = caches
@@ -191,6 +241,12 @@ class CacheGrowth:
         self.growth = {
             c: c.cache_info().currsize - b
             for c, b in zip(self._caches, self._before)}
+        if _tm.active():
+            for c, g in self.growth.items():
+                if g:
+                    name = getattr(c, "__name__", None) or repr(c)
+                    _tm.record_compile(f"cache_growth:{name}", n=g,
+                                       args={"new_entries": g})
 
     @property
     def total(self) -> int:
