@@ -1313,6 +1313,37 @@ def _child_main(run_id):
             note(f"streaming rx stage failed: {e!r}")
             stream_ev = {"error": repr(e)}
 
+    # ISSUE 8 tentpole evidence: the jaxlint static-analysis sweep —
+    # per-rule finding counts (and the suppression count) over
+    # ziria_tpu/, recorded in the artifact so the trend — and any
+    # suppression creep — stays visible across PRs. Pure AST, never
+    # touches the backend (it cannot flake with the tunnel), but it
+    # rides the same resumable never-fatal stage discipline anyway.
+    def _lint_stage():
+        from ziria_tpu.analysis import lint_paths
+        t_l = time.perf_counter()
+        res = lint_paths([os.path.join(REPO, "ziria_tpu")])
+        ev = {"files": res.files,
+              "findings_total": len(res.findings),
+              "findings_by_rule": res.counts,
+              "suppressed": res.suppressed,
+              "t_lint_s": round(time.perf_counter() - t_l, 3)}
+        note(f"lint: {ev['findings_total']} finding(s) over "
+             f"{ev['files']} file(s), {ev['suppressed']} suppressed, "
+             f"{ev['t_lint_s']}s")
+        part("lint", **ev)
+        return ev
+
+    if "lint" in resume:
+        lint_ev = reuse(resume["lint"])
+        note("lint resumed from prior window")
+    else:
+        try:
+            lint_ev = _lint_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"lint stage failed: {e!r}")
+            lint_ev = {"error": repr(e)}
+
     def _percall_fence_stage():
         # per-call diagnostic (tunnel-dispatch-bound upper bound on
         # latency) — always taken at the base batch of 128, which may
@@ -1385,6 +1416,7 @@ def _child_main(run_id):
         "fused_link": fused_ev,
         "ber_sweep": sweep_ev,
         "streaming_rx": stream_ev,
+        "lint": lint_ev,
         "roofline": _roofline(B, frame_len, n_sym, n_psdu_bits, t_tpu),
         "resumed_stages": sorted(set(resumed_stages)),
     }

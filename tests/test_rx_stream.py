@@ -273,15 +273,22 @@ def test_push_flush_carry_threads_across_slabs(corpus):
     """The push-driven surface: the same stream fed in ragged slabs
     through StreamReceiver emits the same frames as the one-shot
     call, with the (tail, offset, emitted) carry threading across
-    chunk boundaries."""
+    chunk boundaries. The whole steady state runs under
+    dispatch.no_recompile — the runtime twin of the jaxlint R1
+    cache-key rule: at the fixture's already-compiled geometry, ragged
+    pushes may only RE-DISPATCH the two compiled chunk programs, never
+    mint a fresh compile-cache entry."""
     stream, starts, got_s, _st, _d, _gp, _sp, _dp = corpus
-    sr = framebatch.StreamReceiver(**GEO)
-    got = []
-    cuts = [0, 777, 3000, 4100, 9001, stream.shape[0]]
-    for a, b in zip(cuts, cuts[1:]):
-        got += sr.push(stream[a:b])
-    assert sr.carry.offset + sr.carry.tail.shape[0] == stream.shape[0]
-    got += sr.flush()
+    with dispatch.no_recompile(rx._jit_stream_chunk,
+                               rx._jit_stream_decode):
+        sr = framebatch.StreamReceiver(**GEO)
+        got = []
+        cuts = [0, 777, 3000, 4100, 9001, stream.shape[0]]
+        for a, b in zip(cuts, cuts[1:]):
+            got += sr.push(stream[a:b])
+        assert sr.carry.offset + sr.carry.tail.shape[0] \
+            == stream.shape[0]
+        got += sr.flush()
     assert sr.carry.emitted == len(got)
     assert [f.start for f in got] == [f.start for f in got_s]
     for a, b in zip(got, got_s):

@@ -1,0 +1,24 @@
+"""jaxlint: ahead-of-time static analysis for the jit disciplines.
+
+The tree's most recurring bug class is a knob that reaches a jitted
+graph without being folded into its compile-cache key (ADVICE r5 #1;
+PR 6 re-threaded three knobs through every ``_jit_*`` factory by
+hand). Ziria's contribution is exactly this kind of pre-codegen
+program analysis (SDF cardinality checking, PAPERS.md), and Sora's
+dedicated-core discipline only works because nothing in the hot loop
+silently synchronizes with the host — both statically checkable here.
+
+Entry points:
+
+    python -m ziria_tpu.analysis [paths...]     # pure AST, no jax
+    python -m ziria_tpu lint [paths...]         # same, via the CLI
+    from ziria_tpu.analysis import lint_paths   # library / gate / bench
+
+Rule catalog, pragma syntax, and how to add a rule:
+docs/static_analysis.md. The tier-1 gate is
+tests/test_lint_clean.py (zero findings over ``ziria_tpu/``).
+"""
+
+from ziria_tpu.analysis.engine import (Finding, LintResult,  # noqa: F401
+                                       lint_paths, lint_source)
+from ziria_tpu.analysis.rules import ALL_RULES, RULES_BY_ID  # noqa: F401

@@ -1,0 +1,77 @@
+"""CLI of the jaxlint static analysis: ``python -m ziria_tpu.analysis``.
+
+Pure-AST — never imports jax — so the gate runs even when the TPU
+backend probe hangs (the exact situation in which you most want a
+host-only check). Exit status: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def _default_target() -> str:
+    """The package's own source tree — `python -m ziria_tpu.analysis`
+    with no arguments lints the checkout it runs from."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from ziria_tpu.analysis.engine import lint_paths
+    from ziria_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+    p = argparse.ArgumentParser(
+        prog="ziria_tpu.analysis",
+        description="jaxlint: AST static analysis for jit-cache-key "
+                    "completeness, host-sync leaks, and knob hygiene "
+                    "(docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "ziria_tpu package directory)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (findings, per-rule "
+                        "counts, suppressed count)")
+    p.add_argument("--rules", metavar="R1,R2,...",
+                   help="run only these rule ids")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id}  {r.name}: {r.why}")
+        return 0
+
+    rules = None
+    if args.rules:
+        ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in ids if r not in RULES_BY_ID]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(RULES_BY_ID)})", file=sys.stderr)
+            return 2
+        rules = [RULES_BY_ID[r] for r in ids]
+
+    paths = args.paths or [_default_target()]
+    missing = [q for q in paths if not os.path.exists(q)]
+    if missing:
+        print(f"no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    res = lint_paths(paths, rules=rules)
+    if args.json:
+        print(res.to_json())
+    else:
+        for f in res.findings:
+            print(f.render())
+        counts = " ".join(f"{k}={v}" for k, v in
+                          sorted(res.counts.items()))
+        print(f"jaxlint: {len(res.findings)} finding(s) "
+              f"[{counts or 'clean'}] across {res.files} file(s), "
+              f"{res.suppressed} suppressed", file=sys.stderr)
+    return 1 if res.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

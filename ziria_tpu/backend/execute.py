@@ -118,12 +118,15 @@ def run_jit_carry(comp: ir.Comp, inputs, carry=None,
         carry = big.init_carry
     else:
         carry = jax.tree.map(jnp.asarray, stage_carry)
+    from ziria_tpu.utils import dispatch
+
     n_bulk = n_iters // big.width
     if n_bulk:
         scan_fn = _jit_scan(big)
         bulk = inputs[: n_bulk * big.take].reshape(
             (n_bulk, big.take) + inputs.shape[1:])
-        carry, ys = scan_fn(carry, jnp.asarray(bulk))
+        with dispatch.timed("execute.scan_bulk"):
+            carry, ys = scan_fn(carry, jnp.asarray(bulk))
         ys = np.asarray(ys)
         outs.append(ys.reshape((n_bulk * big.emit,) + ys.shape[2:]))
 
@@ -136,7 +139,8 @@ def run_jit_carry(comp: ir.Comp, inputs, carry=None,
         pos = n_bulk * big.take
         rem = inputs[pos: pos + rem_iters * small.take].reshape(
             (rem_iters, small.take) + inputs.shape[1:])
-        carry, ys = _jit_scan(small)(carry, jnp.asarray(rem))
+        with dispatch.timed("execute.scan_rem"):
+            carry, ys = _jit_scan(small)(carry, jnp.asarray(rem))
         ys = np.asarray(ys)
         outs.append(ys.reshape((rem_iters * small.emit,) + ys.shape[2:]))
 

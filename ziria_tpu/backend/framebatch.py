@@ -193,6 +193,19 @@ class StepBatcher:
                 r.done = True
 
 
+def batched_acquire_enabled(batched_acquire: Optional[bool] = None) -> bool:
+    """The ONE reading of the --batched-acquire / ZIRIA_BATCHED_ACQUIRE
+    knob (default ON): whether `receive_many` runs the one-dispatch
+    vmapped acquisition front end or the host-driven per-capture loop.
+    Hoisted out of `receive_many`'s body by the jaxlint R4 audit — the
+    single-reader discipline every other knob here already follows."""
+    import os
+
+    if batched_acquire is not None:
+        return batched_acquire
+    return os.environ.get("ZIRIA_BATCHED_ACQUIRE", "1") != "0"
+
+
 def receive_many(captures: Sequence[Any], check_fcs: bool = False,
                  max_samples: int = 1 << 16,
                  viterbi_window: int = None,
@@ -228,15 +241,11 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
     does not apply to the mixed decode (rate-static tables — see
     rx.decode_data_mixed), so there is no knob for it here.
     """
-    import os
-
     import jax.numpy as jnp
 
     from ziria_tpu.phy.wifi import rx as _rx
 
-    if batched_acquire is None:
-        batched_acquire = os.environ.get(
-            "ZIRIA_BATCHED_ACQUIRE", "1") != "0"
+    batched_acquire = batched_acquire_enabled(batched_acquire)
 
     results: List[Any] = [None] * len(captures)
     if batched_acquire:
@@ -305,8 +314,11 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
     if check_fcs:
         npsdu = jnp.asarray([8 * a.length_bytes for _i, a in padded],
                             jnp.int32)
+        # host pull outside the timed block (jaxlint R2): the site
+        # times the dispatch, not the device wait
         with dispatch.timed("rx.crc_many"):
-            crc_b = np.asarray(_rx._jit_crc_many()(clear_dev, npsdu))
+            crc_dev = _rx._jit_crc_many()(clear_dev, npsdu)
+        crc_b = np.asarray(crc_dev)
     clear = np.asarray(clear_dev, np.uint8)
     for k, (i, a) in enumerate(acqs):
         psdu = clear[k][N_SERVICE_BITS: N_SERVICE_BITS

@@ -1137,6 +1137,16 @@ _VECTOR_SAFE_CALLS = _BASE_TYPE_NAMES | frozenset(
 VECTORIZE_STMT_LOOPS = True
 
 
+def _vector_loops_enabled() -> bool:
+    """The ONE reading of the ZIRIA_NO_VECTOR_LOOPS escape hatch
+    (combined with the module kill switch) — the designated
+    single-reader form the jaxlint R4 hygiene rule enforces."""
+    import os
+
+    return VECTORIZE_STMT_LOOPS \
+        and not os.environ.get("ZIRIA_NO_VECTOR_LOOPS")
+
+
 class _VectorBail(Exception):
     """Body not vectorizable (analysis or runtime shape failure)."""
 
@@ -1491,9 +1501,7 @@ def _vectorized_for(start: int, count: int, st: A.SFor, scope: Scope,
     the per-iteration while-op cost on the VPU. Returns True when it
     ran; False leaves all state untouched (caller falls back to
     lax.fori_loop staging)."""
-    import os
-    if not VECTORIZE_STMT_LOOPS \
-            or os.environ.get("ZIRIA_NO_VECTOR_LOOPS"):
+    if not _vector_loops_enabled():
         return False
     plan = _vector_plan(st, scope, ctx)
     if plan is None:

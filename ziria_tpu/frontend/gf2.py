@@ -769,11 +769,18 @@ def _plan(st: A.SFor, scope, ctx, start: int,
 # --------------------------------------------------------------------------
 
 
+def _gf2_loops_enabled() -> bool:
+    """The ONE reading of the ZIRIA_NO_GF2_LOOPS escape hatch — the
+    designated single-reader form the jaxlint R4 hygiene rule
+    enforces."""
+    return not os.environ.get("ZIRIA_NO_GF2_LOOPS")
+
+
 def gf2_for(start, count, st: A.SFor, scope, ctx) -> bool:
     """Try to run `for var in [start, count] body` as composed GF(2)
     block steps. Returns True when it fully handled the loop (state
     and outputs updated); False leaves all state untouched."""
-    if os.environ.get("ZIRIA_NO_GF2_LOOPS"):
+    if not _gf2_loops_enabled():
         return False
     try:
         start_i = int(start)     # raises on a traced start: unsupported

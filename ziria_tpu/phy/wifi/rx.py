@@ -997,9 +997,11 @@ def receive(samples, check_fcs: bool = False,
         None if fxp else viterbi._check_radix(viterbi_radix),
         None if fxp else fused_demap_enabled(fused_demap))
     from ziria_tpu.utils import dispatch
+    # the host pull stays OUTSIDE the timed block: the site times the
+    # dispatch, not the device wait (jaxlint R2 — docs/static_analysis.md)
     with dispatch.timed("rx.decode_bucketed"):
-        clear = np.asarray(
-            dec(seg, jnp.int32(acq.n_sym * rate.n_dbps)), np.uint8)
+        clear_dev = dec(seg, jnp.int32(acq.n_sym * rate.n_dbps))
+    clear = np.asarray(clear_dev, np.uint8)
     psdu = clear[N_SERVICE_BITS: N_SERVICE_BITS + 8 * acq.length_bytes]
     crc = bool(np.asarray(check_crc32(psdu))) if check_fcs else None
     return RxResult(True, acq.rate_mbps, acq.length_bytes, psdu, crc)

@@ -25,6 +25,9 @@ Example:
 
 from __future__ import annotations
 
+# ziria: lint-ignore-file[R4] this module OWNS the scoped-env pattern:
+# its flag writes are paired with the finally-restore in main(), and its
+# reads mirror argparse defaults for the same invocation-scoped knobs
 import argparse
 import os
 import sys
@@ -127,7 +130,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ziria_tpu",
         description="TPU-native stream pipeline driver "
-                    "(reference-style params)")
+                    "(reference-style params)",
+        epilog="subcommand: `python -m ziria_tpu lint [paths...]` runs "
+               "the jaxlint static analysis (pure AST, no jax import; "
+               "docs/static_analysis.md)")
     p.add_argument("--prog", help="registered pipeline name")
     p.add_argument("--src", help="Ziria-like source file (.zir) to compile")
     p.add_argument("--list-progs", action="store_true")
@@ -678,6 +684,15 @@ def _run_profiled(comp, xs, args):
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "lint":
+        # jaxlint subcommand: pure-AST static analysis of the jit
+        # disciplines (docs/static_analysis.md). Dispatched BEFORE
+        # argparse and without touching jax, so the gate runs even
+        # when the TPU backend probe hangs.
+        from ziria_tpu.analysis.__main__ import main as lint_main
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     _apply_platform(args.platform)
     _apply_compile_cache(args.compile_cache)

@@ -268,3 +268,31 @@ def cache_growth(*caches):
         yield g
     finally:
         g._finish()
+
+
+@contextmanager
+def no_recompile(*caches):
+    """``with no_recompile(rx._jit_stream_chunk): ...`` — assert the
+    block added ZERO entries to each jit-factory ``lru_cache``: the
+    runtime twin of the jaxlint R1 cache-key rule
+    (docs/static_analysis.md). The static rule proves every knob IS in
+    the key; this proves a steady-state path never mints a fresh key —
+    i.e. re-dispatches compiled programs instead of recompiling.
+    Raises AssertionError naming the grown caches on a clean exit; an
+    exception from the block propagates unmasked (growth is not
+    checked — the block didn't finish its steady state)."""
+    with cache_growth(*caches) as g:
+        yield g
+    # only reached on a clean block exit: an exception propagates
+    # through the yield and skips the growth assertion
+    grown = {}
+    for c, n in g.growth.items():
+        if n:
+            name = getattr(c, "__name__", None) or repr(c)
+            mod = getattr(c, "__module__", None)
+            grown[f"{mod}.{name}" if mod else name] = n
+    if grown:
+        raise AssertionError(
+            f"no_recompile: block minted fresh compile-cache entries "
+            f"{grown} — a knob or geometry is reaching the jit "
+            f"factory without riding its cache key")
