@@ -12,11 +12,24 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_no_tpu_emits_driver_contract():
+def test_bench_no_tpu_emits_driver_contract(tmp_path):
+    # BENCH_TRAJECTORY redirect: the run must append its perf-ledger
+    # records (ISSUE 9 acceptance: a fresh run appends), but a TEST
+    # run must never dirty the committed BENCH_TRAJECTORY.jsonl
+    traj = tmp_path / "traj.jsonl"
+    env = dict(os.environ, BENCH_TRAJECTORY=str(traj))
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--no-tpu"],
-        capture_output=True, text=True, timeout=300, cwd=REPO)
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
     assert out.returncode == 0, out.stderr[-500:]
+    recs = [json.loads(ln) for ln in
+            traj.read_text().strip().splitlines()]
+    stages = {r["stage"] for r in recs}
+    assert "numpy_baseline" in stages and "result" in stages
+    for r in recs:
+        for key in ("run_id", "unix", "stage", "metric", "value",
+                    "platform", "partial", "direction"):
+            assert key in r, (key, r)
     lines = [ln for ln in out.stdout.strip().splitlines() if ln]
     assert len(lines) == 1, f"expected ONE JSON line, got {len(lines)}"
     j = json.loads(lines[0])

@@ -7,6 +7,26 @@
 set -u
 cd "$(git rev-parse --show-toplevel)"
 [ "${ZIRIA_SKIP_TESTGATE:-0}" = "1" ] && exit 0
+
+# jaxlint gate (ISSUE 8/9): pure AST, no jax import, sub-5s — a
+# cache-key/hygiene finding must not reach a commit
+if ! python -m ziria_tpu lint ziria_tpu/; then
+  echo "[precommit] jaxlint found issues — commit refused" >&2
+  echo "[precommit] (ZIRIA_SKIP_TESTGATE=1 to override for WIP)" >&2
+  exit 1
+fi
+
+# perf-ledger regression gate (ISSUE 9): latest vs previous
+# same-platform run in BENCH_TRAJECTORY.jsonl. Lenient tolerance —
+# bench numbers on a shared box are noisy; the gate exists to catch
+# collapses, not jitter. Exits 0 when there is nothing to compare.
+if ! python tools/perf_report.py --check --tolerance 0.5; then
+  echo "[precommit] perf_report --check flagged a regression in" \
+       "BENCH_TRAJECTORY.jsonl — commit refused" >&2
+  echo "[precommit] (ZIRIA_SKIP_TESTGATE=1 to override for WIP)" >&2
+  exit 1
+fi
+
 mapfile -t staged < <(git diff --cached --name-only --diff-filter=ACM |
                       grep -E '^tests/test_.*\.py$' || true)
 [ ${#staged[@]} -eq 0 ] && exit 0

@@ -76,7 +76,13 @@ smoke or a manual chip window:
   report per-site latency DISTRIBUTIONS (p50/p90/p99/max ms) off the
   utils/telemetry histogram layer (``latency_ms_*`` blocks), and
   ``streaming_stats(trace_path=...)`` leaves a Chrome trace of one
-  streaming pass for tools/trace_report.py.
+  streaming pass for tools/trace_report.py. Since ISSUE 9 both blocks
+  additionally report ``roofline_by_site`` — achieved GB/s / GFLOP/s
+  and %-of-peak per dispatch site from XLA's own cost analysis
+  (utils/programs observatory) x the measured p50, replacing hand
+  byte/FLOP formulas with compiled-graph truth — and the exported
+  trace embeds the ``siteCosts``/``devicePeaks`` riders so
+  trace_report prints GB/s per span label.
 
 Standalone: ``ZIRIA_TOOL_ALLOW_CPU=1 python tools/rx_dispatch_bench.py``
 runs all at shrunk sizes on CPU (results labelled platform=cpu,
@@ -114,6 +120,42 @@ def _timed(fn, *args, reps=1, tries=3):
         _fence(o)
         best = min(best, (time.perf_counter() - t0) / reps)
     return best
+
+
+def _roofline_by_site(obs, lat_blocks, device_kind):
+    """Per-site achieved GB/s / GFLOP/s (ISSUE 9): XLA's own cost
+    analysis for the site's compiled program (utils/programs — the
+    observatory noted fn+avals at the dispatch site) divided by the
+    site's measured p50 latency from the telemetry histograms. The
+    p50 is the histogram's power-of-two bucket UPPER bound (<= 2x the
+    true p50), so the achieved numbers are conservative lower bounds.
+    ``pct_hbm_peak``/``pct_flops_peak`` appear only for device kinds
+    in the peaks table (utils/programs.DEVICE_PEAKS) — unknown kinds
+    report absolutes, never a percentage of the wrong ceiling."""
+    from ziria_tpu.utils import programs
+
+    lat = {}
+    for b in lat_blocks:
+        lat.update({k: v for k, v in b.items() if v})
+    out = {}
+    for site, c in sorted(obs.site_costs().items()):
+        row = {"flops": c["flops"],
+               "bytes_accessed": c["bytes_accessed"]}
+        if c.get("peak_bytes"):
+            row["peak_bytes"] = c["peak_bytes"]
+        p50_ms = (lat.get(site) or {}).get("p50")
+        if p50_ms:
+            row["p50_ms"] = p50_ms
+            row.update(programs.roofline(
+                p50_ms / 1e3, bytes_accessed=c["bytes_accessed"],
+                flops=c["flops"], device_kind=device_kind))
+        out[site] = row
+    return out
+
+
+def _device_kind():
+    import jax
+    return getattr(jax.devices()[0], "device_kind", "?")
 
 
 def _latency_block(reg):
@@ -387,22 +429,27 @@ def fused_link_stats(n_frames=8, n_bytes=100, snr_db=28.0):
     kw = dict(snr_db=snr_db, cfo=cfo, delay=delay, seed=6,
               add_fcs=True, check_fcs=True)
 
-    from ziria_tpu.utils import telemetry
+    from ziria_tpu.utils import programs, telemetry
 
     # collect() around BOTH the counted run and the timed repeats so
     # the per-site latency histograms hold enough samples for the
-    # p50/p99 bounds to mean something
-    with telemetry.collect() as reg_st:
-        with count_dispatches() as d_st:
-            res_s = link.loopback_many(psdus, mbps, fused=False, **kw)
-        t_st = _timed(lambda: link.loopback_many(
-            psdus, mbps, fused=False, **kw))
+    # p50/p99 bounds to mean something; the observatory wraps both
+    # variants so every fired site contributes its compiled program's
+    # analytical cost to the per-site roofline block
+    with programs.observing() as obs:
+        with telemetry.collect() as reg_st:
+            with count_dispatches() as d_st:
+                res_s = link.loopback_many(psdus, mbps, fused=False,
+                                           **kw)
+            t_st = _timed(lambda: link.loopback_many(
+                psdus, mbps, fused=False, **kw))
 
-    with telemetry.collect() as reg_fu:
-        with count_dispatches() as d_fu:
-            res_f = link.loopback_many(psdus, mbps, fused=True, **kw)
-        t_fu = _timed(lambda: link.loopback_many(
-            psdus, mbps, fused=True, **kw))
+        with telemetry.collect() as reg_fu:
+            with count_dispatches() as d_fu:
+                res_f = link.loopback_many(psdus, mbps, fused=True,
+                                           **kw)
+            t_fu = _timed(lambda: link.loopback_many(
+                psdus, mbps, fused=True, **kw))
 
     assert all(a.ok == b.ok and a.crc_ok == b.crc_ok
                and a.rate_mbps == b.rate_mbps
@@ -425,6 +472,13 @@ def fused_link_stats(n_frames=8, n_bytes=100, snr_db=28.0):
         # p50/p99 the serving work asks for
         "latency_ms_staged": _latency_block(reg_st),
         "latency_ms_fused": _latency_block(reg_fu),
+        # per-site achieved GB/s / GFLOP/s and %-of-peak from XLA
+        # cost analysis x measured p50 — the "link.fused" row is the
+        # fused dispatch's distance to the roofline (compiled-graph
+        # truth, not bench.py's hand formulas)
+        "roofline_by_site": _roofline_by_site(
+            obs, [_latency_block(reg_st), _latency_block(reg_fu)],
+            _device_kind()),
         "t_staged_s": round(t_st, 4),
         "t_fused_s": round(t_fu, 4),
         "fps_staged": round(n_frames / t_st, 1),
@@ -516,27 +570,46 @@ def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
     kw = dict(chunk_len=chunk_len, frame_len=frame_len,
               max_frames_per_chunk=k, check_fcs=True)
 
-    # collect() spans the counted run AND the timed repeats: the
-    # per-chunk latency histograms see chunks x repeats samples
-    with telemetry.collect() as reg_pc:
-        with count_dispatches() as d_pc:
-            res_p, st_p = framebatch.receive_stream(
-                stream, streaming=False, **kw)
-        t_pc = _timed(lambda: framebatch.receive_stream(
-            stream, streaming=False, **kw))
+    from ziria_tpu.utils import programs
 
-    with telemetry.collect() as reg_st:
-        with count_dispatches() as d_st:
-            res_s, st_s = framebatch.receive_stream(
-                stream, streaming=True, **kw)
-        t_st = _timed(lambda: framebatch.receive_stream(
-            stream, streaming=True, **kw))
+    # collect() spans the counted run AND the timed repeats: the
+    # per-chunk latency histograms see chunks x repeats samples; the
+    # observatory wraps both paths so the chunk-scan and decode
+    # programs contribute their compiled cost to the per-site roofline
+    with programs.observing() as obs:
+        with telemetry.collect() as reg_pc:
+            with count_dispatches() as d_pc:
+                res_p, st_p = framebatch.receive_stream(
+                    stream, streaming=False, **kw)
+            t_pc = _timed(lambda: framebatch.receive_stream(
+                stream, streaming=False, **kw))
+
+        with telemetry.collect() as reg_st:
+            with count_dispatches() as d_st:
+                res_s, st_s = framebatch.receive_stream(
+                    stream, streaming=True, **kw)
+            t_st = _timed(lambda: framebatch.receive_stream(
+                stream, streaming=True, **kw))
+
+    roofline_by_site = _roofline_by_site(
+        obs, [_latency_block(reg_pc), _latency_block(reg_st)],
+        _device_kind())
 
     if trace_path:
         # one warm streaming pass under an exporting trace: spans +
-        # counter tracks + (warm, so few) compile events
-        with telemetry.tracing(trace_path):
+        # counter tracks + (warm, so few) compile events — plus the
+        # observatory's analytical site costs and the device peaks as
+        # trace metadata, so tools/trace_report.py can print achieved
+        # GB/s per span label straight off the file
+        with telemetry.tracing(trace_path) as tr:
             framebatch.receive_stream(stream, streaming=True, **kw)
+            tr.set_metadata("siteCosts", {
+                s: {"flops": r["flops"],
+                    "bytes_accessed": r["bytes_accessed"]}
+                for s, r in roofline_by_site.items()})
+            tr.set_metadata("deviceKind", _device_kind())
+            tr.set_metadata("devicePeaks",
+                            programs.peaks_for(_device_kind()))
 
     assert [f.start for f in res_s] == list(starts), \
         "streaming starts diverged from the synthesizer ground truth"
@@ -569,6 +642,10 @@ def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
         # harness will report against SLOs — not a summed mean
         "latency_ms_streaming": _latency_block(reg_st),
         "latency_ms_percapture": _latency_block(reg_pc),
+        # per-site roofline from the compiled graphs: achieved GB/s /
+        # GFLOP/s per dispatch site (rx.stream_chunk is the number the
+        # serving work reports against the hardware ceiling)
+        "roofline_by_site": roofline_by_site,
         "trace_path": trace_path,
         "max_in_flight": st_s.max_in_flight,
         "overflow_chunks": st_s.overflow_chunks,

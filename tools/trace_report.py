@@ -15,20 +15,70 @@ events in separate sections, plus the counter tracks' last/max levels.
 it exports so every bench run leaves a readable summary next to its
 JSON artifacts; `tests/test_telemetry.py` pins the parse against
 traces the layer actually writes.
+
+When the trace object carries the program observatory's riders
+(``siteCosts``: per-label analytical FLOPs / bytes accessed from XLA
+cost analysis; optional ``devicePeaks``/``deviceKind`` — all embedded
+by `tools/rx_dispatch_bench.streaming_stats`), the span table grows
+achieved GB/s and %-of-HBM-peak columns: measured p50 x compiled-graph
+bytes, per dispatch site. ``--costs FILE`` supplies the same data
+externally (the ``python -m ziria_tpu programs --json`` report, or a
+bare ``{label: {bytes_accessed, flops}}`` map).
+
+    python tools/trace_report.py --compare A.json B.json \
+        [--threshold 0.2]
+
+compares two traces per label (p50/p99 delta table, reusing the same
+parse); with ``--threshold``, a p50 regression beyond the fraction on
+any shared label exits 1 — a trace-level perf gate to go with
+tools/perf_report.py's trajectory gate.
 """
 
 import json
 import sys
 
 
-def load(path):
-    """The trace's event list. Accepts both the exported object form
-    ({"traceEvents": [...]}) and a bare JSON array of events."""
+def load_obj(path):
+    """The raw exported object ({"traceEvents": [...], riders...}) or
+    a bare event array wrapped into that form."""
     with open(path) as f:
         obj = json.load(f)
     if isinstance(obj, dict):
-        return obj.get("traceEvents", [])
-    return obj
+        return obj
+    return {"traceEvents": obj}
+
+
+def load(path):
+    """The trace's event list. Accepts both the exported object form
+    ({"traceEvents": [...]}) and a bare JSON array of events."""
+    return load_obj(path).get("traceEvents", [])
+
+
+def site_costs_of(obj):
+    """Normalize a costs rider/file into {label: {"bytes_accessed",
+    "flops"}}: accepts the trace's embedded ``siteCosts``, the
+    ``programs --json`` report (``programs`` record list, keyed by
+    ``label``; the largest-bytes record per label wins), or a bare
+    label->cost map."""
+    if not isinstance(obj, dict):
+        return {}
+    if "siteCosts" in obj:
+        obj = obj["siteCosts"]
+    if "programs" in obj and isinstance(obj["programs"], list):
+        out = {}
+        for r in obj["programs"]:
+            label = r.get("label")
+            if not label or r.get("error") or \
+                    not r.get("bytes_accessed"):
+                continue
+            cur = out.get(label)
+            if cur is None or r["bytes_accessed"] > \
+                    cur["bytes_accessed"]:
+                out[label] = {"bytes_accessed": r["bytes_accessed"],
+                              "flops": r.get("flops", 0.0)}
+        return out
+    return {k: v for k, v in obj.items()
+            if isinstance(v, dict) and v.get("bytes_accessed")}
 
 
 def _rank(sorted_vals, q):
@@ -96,24 +146,45 @@ def summarize(events):
             "compile_markers": markers, "counters": counters}
 
 
-def format_table(summary):
-    """The human-readable report: one aligned table per section."""
+def format_table(summary, site_costs=None, peaks=None):
+    """The human-readable report: one aligned table per section. With
+    ``site_costs`` (label -> analytical cost), the span rows gain
+    achieved GB/s (compiled-graph bytes / measured p50) and — when the
+    device peaks are known — %-of-HBM-peak."""
     lines = []
+    site_costs = site_costs or {}
 
-    def section(title, rows):
+    def section(title, rows, costs=None):
         if not rows:
             return
         lines.append(title)
         w = max(len(k) for k in rows)
-        lines.append(f"  {'label':<{w}} {'count':>6} {'p50 ms':>9} "
-                     f"{'p99 ms':>9} {'max ms':>9} {'total ms':>10}")
+        head = (f"  {'label':<{w}} {'count':>6} {'p50 ms':>9} "
+                f"{'p99 ms':>9} {'max ms':>9} {'total ms':>10}")
+        if costs:
+            head += f" {'GB/s':>8}"
+            if peaks:
+                head += f" {'%HBM':>7}"
+        lines.append(head)
         for label, r in rows.items():
-            lines.append(
+            line = (
                 f"  {label:<{w}} {r['count']:>6} {r['p50_ms']:>9.3f} "
                 f"{r['p99_ms']:>9.3f} {r['max_ms']:>9.3f} "
                 f"{r['total_ms']:>10.3f}")
+            if costs:
+                c = costs.get(label)
+                if c and r["p50_ms"] > 0:
+                    gbps = c["bytes_accessed"] / (r["p50_ms"] / 1e3) / 1e9
+                    line += f" {gbps:>8.2f}"
+                    if peaks:
+                        pct = 100 * gbps / peaks["hbm_gbps"]
+                        line += f" {pct:>7.2f}"
+                else:
+                    line += f" {'-':>8}" + (f" {'-':>7}" if peaks
+                                            else "")
+            lines.append(line)
 
-    section("spans:", summary["spans"])
+    section("spans:", summary["spans"], site_costs)
     section("compile events:", summary["compiles"])
     if summary["compile_markers"]:
         lines.append("compile markers (cache growth):")
@@ -127,23 +198,122 @@ def format_table(summary):
     return "\n".join(lines)
 
 
-def summarize_file(path):
+def summarize_file(path, costs_path=None):
     """(summary dict, formatted table) for a trace file — the one-call
-    surface bench.py's streaming stage uses."""
-    s = summarize(load(path))
-    return s, format_table(s)
+    surface bench.py's streaming stage uses. Cost columns come from
+    the trace's embedded ``siteCosts`` rider, overridable/suppliable
+    via ``costs_path``."""
+    def usable_peaks(p):
+        # only a single resolved {hbm_gbps, ...} entry renders %HBM —
+        # a per-kind TABLE or anything else is not a ceiling
+        return p if isinstance(p, dict) and "hbm_gbps" in p else None
+
+    obj = load_obj(path)
+    s = summarize(obj.get("traceEvents", []))
+    costs = site_costs_of(obj)
+    peaks = usable_peaks(obj.get("devicePeaks"))
+    if costs_path:
+        with open(costs_path) as f:
+            ext = json.load(f)
+        costs = site_costs_of(ext) or costs
+        if isinstance(ext, dict) and "devicePeaks" in ext:
+            peaks = usable_peaks(ext["devicePeaks"]) or peaks
+    return s, format_table(s, site_costs=costs, peaks=peaks)
+
+
+def compare_summaries(sa, sb, threshold=None):
+    """Per-label span delta between two summaries. Returns (rows,
+    regressed): rows are (label, count_a, count_b, p50_a, p50_b,
+    dp50_frac, p99_a, p99_b) over the union of span labels;
+    ``regressed`` holds labels whose p50 grew by more than
+    ``threshold`` (fraction) — None disables flagging."""
+    rows, regressed = [], []
+    labels = sorted(set(sa["spans"]) | set(sb["spans"]))
+    for label in labels:
+        a = sa["spans"].get(label)
+        b = sb["spans"].get(label)
+        if a is None or b is None:
+            rows.append((label,
+                         a and a["count"], b and b["count"],
+                         a and a["p50_ms"], b and b["p50_ms"], None,
+                         a and a["p99_ms"], b and b["p99_ms"]))
+            continue
+        frac = ((b["p50_ms"] - a["p50_ms"]) / a["p50_ms"]
+                if a["p50_ms"] > 0 else None)
+        rows.append((label, a["count"], b["count"], a["p50_ms"],
+                     b["p50_ms"], frac, a["p99_ms"], b["p99_ms"]))
+        if threshold is not None and frac is not None \
+                and frac > threshold:
+            regressed.append(label)
+    return rows, regressed
+
+
+def format_compare(rows, name_a="A", name_b="B", regressed=()):
+    w = max([len("label")] + [len(r[0]) for r in rows])
+    lines = [f"{'label':<{w}} {'n(A)':>6} {'n(B)':>6} "
+             f"{'p50 A ms':>9} {'p50 B ms':>9} {'d p50':>7} "
+             f"{'p99 A ms':>9} {'p99 B ms':>9}  flag"]
+
+    def fmt(v, spec):
+        return format(v, spec) if v is not None else "-"
+
+    for label, ca, cb, p50a, p50b, frac, p99a, p99b in rows:
+        lines.append(
+            f"{label:<{w}} {fmt(ca, '>6')} {fmt(cb, '>6')} "
+            f"{fmt(p50a, '>9.3f')} {fmt(p50b, '>9.3f')} "
+            f"{fmt(frac, '>+7.1%')} "
+            f"{fmt(p99a, '>9.3f')} {fmt(p99b, '>9.3f')}  "
+            f"{'REGRESSED' if label in regressed else ''}")
+    lines.append(f"A = {name_a}, B = {name_b}")
+    return "\n".join(lines)
 
 
 def main(argv=None):
-    argv = argv if argv is not None else sys.argv[1:]
-    if len(argv) != 1:
-        print("usage: python tools/trace_report.py TRACE.json",
-              file=sys.stderr)
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="trace_report",
+        description="per-label latency summary of a telemetry Chrome "
+                    "trace; --compare diffs two traces")
+    ap.add_argument("traces", nargs="*", metavar="TRACE.json")
+    ap.add_argument("--costs", metavar="FILE", default=None,
+                    help="per-label analytical costs (siteCosts map or"
+                         " a `programs --json` report) for the GB/s "
+                         "columns")
+    ap.add_argument("--compare", nargs=2, metavar=("A.json", "B.json"),
+                    default=None,
+                    help="per-label p50/p99 delta table between two "
+                         "traces")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="with --compare: exit 1 when any label's p50 "
+                         "regressed by more than this fraction")
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        pa, pb = args.compare
+        try:
+            sa = summarize(load(pa))
+            sb = summarize(load(pb))
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read trace: {e}", file=sys.stderr)
+            return 1
+        rows, regressed = compare_summaries(sa, sb, args.threshold)
+        print(format_compare(rows, pa, pb, regressed)
+              or "(no spans)")
+        if regressed:
+            print(f"trace_report: {len(regressed)} label(s) regressed "
+                  f"beyond {args.threshold:.0%}", file=sys.stderr)
+            return 1
+        return 0
+
+    if len(args.traces) != 1:
+        ap.print_usage(sys.stderr)
         return 2
     try:
-        _s, table = summarize_file(argv[0])
+        _s, table = summarize_file(args.traces[0],
+                                   costs_path=args.costs)
     except (OSError, ValueError) as e:
-        print(f"error: cannot read trace {argv[0]!r}: {e}",
+        print(f"error: cannot read trace {args.traces[0]!r}: {e}",
               file=sys.stderr)
         return 1
     print(table or "(empty trace)")

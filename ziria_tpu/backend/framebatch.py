@@ -298,7 +298,7 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
     from ziria_tpu.ops.viterbi import _check_radix
     from ziria_tpu.phy.wifi import rx as _rx
     from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
     ridx = jnp.asarray([_rx.RATE_INDEX[a.rate_mbps] for _i, a in padded],
                        jnp.int32)
@@ -308,16 +308,19 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
     dec = _rx._jit_decode_data_mixed(n_sym_b, viterbi_window,
                                      viterbi_metric,
                                      _check_radix(viterbi_radix))
+    programs.note_site("rx.decode_mixed", dec, segs, ridx, nbits)
     with dispatch.timed("rx.decode_mixed"):
         clear_dev = dec(segs, ridx, nbits)
     crc_b = None
     if check_fcs:
         npsdu = jnp.asarray([8 * a.length_bytes for _i, a in padded],
                             jnp.int32)
+        crc_fn = _rx._jit_crc_many()
+        programs.note_site("rx.crc_many", crc_fn, clear_dev, npsdu)
         # host pull outside the timed block (jaxlint R2): the site
         # times the dispatch, not the device wait
         with dispatch.timed("rx.crc_many"):
-            crc_dev = _rx._jit_crc_many()(clear_dev, npsdu)
+            crc_dev = crc_fn(clear_dev, npsdu)
         crc_b = np.asarray(crc_dev)
     clear = np.asarray(clear_dev, np.uint8)
     for k, (i, a) in enumerate(acqs):
@@ -562,7 +565,7 @@ class StreamReceiver:
         import jax
         import jax.numpy as jnp
 
-        from ziria_tpu.utils import dispatch
+        from ziria_tpu.utils import dispatch, programs
 
         # the stream's FIRST chunk owns head-truncated preambles whose
         # LTS alignment lands below 0 (clamped to 0 on device, exactly
@@ -570,9 +573,11 @@ class StreamReceiver:
         # negative start is the previous chunk's frame
         own_lo = -192 if self._offset == 0 else 0
         dev = jax.device_put(arr)
+        chunk_args = (dev, jnp.int32(valid), jnp.int32(own_lo),
+                      jnp.int32(own_hi))
+        programs.note_site("rx.stream_chunk", self._jit1, *chunk_args)
         with dispatch.timed("rx.stream_chunk"):
-            outs = self._jit1(dev, jnp.int32(valid), jnp.int32(own_lo),
-                              jnp.int32(own_hi))
+            outs = self._jit1(*chunk_args)
         self._chunks += 1
         self._inflight += 1
         self._max_in_flight = max(self._max_in_flight, self._inflight)
@@ -588,7 +593,7 @@ class StreamReceiver:
         per-capture `rx.receive` per window in oracle mode)."""
         from ziria_tpu.phy.wifi import rx as _rx
         from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
-        from ziria_tpu.utils import dispatch
+        from ziria_tpu.utils import dispatch, programs
 
         off, arr, valid, outs = pend
         (own, starts, overflow, found, fstart, eps, rb, ln, pk, nv,
@@ -668,6 +673,8 @@ class StreamReceiver:
                                          self.viterbi_window,
                                          self.viterbi_metric,
                                          self.viterbi_radix)
+            programs.note_site("rx.stream_decode", dec, segs, rows,
+                               ridx, nbits, npsdu)
             with dispatch.timed("rx.stream_decode"):
                 clear, crc = dec(segs, rows, ridx, nbits, npsdu)
             clear = np.asarray(clear, np.uint8)

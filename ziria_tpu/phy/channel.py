@@ -136,7 +136,7 @@ def impair_many(x_b, n_valid, snr_db, eps, delay, seed,
     delay (scalars broadcast); `seed` one int — lane keys derive by
     counter fold-in (`lane_key`). Bit-identical per lane to a
     single-lane `impair_graph` call with the same key."""
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
     r = int(x_b.shape[0])
     if out_len is None:
@@ -146,11 +146,13 @@ def impair_many(x_b, n_valid, snr_db, eps, delay, seed,
         a = np.broadcast_to(np.asarray(v, dtype), (r,))
         return jnp.asarray(a)
 
+    imp_fn = _jit_impair_many(int(out_len))
+    imp_args = (x_b, _vec(n_valid, np.int32), _vec(snr_db, np.float32),
+                _vec(eps, np.float32), _vec(delay, np.int32),
+                jnp.uint32(seed))
+    programs.note_site("channel.impair_many", imp_fn, *imp_args)
     with dispatch.timed("channel.impair_many"):
-        return _jit_impair_many(int(out_len))(
-            x_b, _vec(n_valid, np.int32), _vec(snr_db, np.float32),
-            _vec(eps, np.float32), _vec(delay, np.int32),
-            jnp.uint32(seed))
+        return imp_fn(*imp_args)
 
 
 @lru_cache(maxsize=None)
@@ -164,15 +166,18 @@ def impair_one(samples, snr_db, eps, delay, seed, lane: int,
     through the SAME graph with the SAME counter-derived key
     (`lane_key(seed, lane)`), the frame zero-padded to `out_len`
     host-side. Bit-identical to row `lane` of the batched dispatch."""
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
     x = np.zeros((int(out_len), 2), np.float32)
     s = np.asarray(samples, np.float32)
     x[:s.shape[0]] = s
+    imp_fn = _jit_impair_one()
+    imp_args = (jnp.asarray(x), jnp.int32(s.shape[0]),
+                jnp.float32(snr_db), jnp.float32(eps),
+                jnp.int32(delay), lane_key(seed, lane))
+    programs.note_site("channel.impair", imp_fn, *imp_args)
     with dispatch.timed("channel.impair"):
-        return _jit_impair_one()(
-            jnp.asarray(x), jnp.int32(s.shape[0]), jnp.float32(snr_db),
-            jnp.float32(eps), jnp.int32(delay), lane_key(seed, lane))
+        return imp_fn(*imp_args)
 
 
 def impair_stream(stream, n_signal: int, snr_db, eps, seed) -> np.ndarray:

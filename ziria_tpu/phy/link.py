@@ -52,7 +52,7 @@ from ziria_tpu.phy import channel
 from ziria_tpu.phy.wifi import rx, tx
 from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, \
     RATE_MBPS_ORDER, RATES, n_symbols
-from ziria_tpu.utils import dispatch
+from ziria_tpu.utils import dispatch, programs
 from ziria_tpu.utils.dispatch import pad_lanes, pow2_ceil
 
 
@@ -225,10 +225,12 @@ def _loopback_staged(geo: _LinkGeometry, seed, check_fcs,
     bit-identical oracle): one encode_many dispatch, one impair_many
     dispatch, then receive_many_device's acquire → gather → decode
     (+ CRC) over the device-resident capture batch."""
+    enc_fn = tx._jit_encode_many(geo.bit_b, geo.sym_b)
+    enc_args = (jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
+                jnp.asarray(geo.ridx_b))
+    programs.note_site("tx.encode_many", enc_fn, *enc_args)
     with dispatch.timed("tx.encode_many"):
-        samples = tx._jit_encode_many(geo.bit_b, geo.sym_b)(
-            jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
-            jnp.asarray(geo.ridx_b))
+        samples = enc_fn(*enc_args)
     caps = channel.impair_many(
         samples, geo.nv_tx, geo.snr, geo.eps, geo.dly, seed,
         out_len=geo.l_cap)
@@ -307,13 +309,16 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
     nothing for the guard."""
     fn = _jit_fused_link(geo.rows, geo.bit_b, geo.sym_b, geo.l_cap,
                          viterbi_window, viterbi_metric, viterbi_radix)
+    fused_args = (
+        jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
+        jnp.asarray(geo.ridx_b), jnp.asarray(geo.nv_tx),
+        jnp.asarray(geo.snr), jnp.asarray(geo.eps),
+        jnp.asarray(geo.dly), jnp.uint32(seed),
+        jnp.asarray(geo.ndata_b))
+    programs.note_site("link.fused", fn, *fused_args)
     with dispatch.timed("link.fused"):
         status, mbps_sig, len_sig, nsym_sig, clear, crc_ok = fn(
-            jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
-            jnp.asarray(geo.ridx_b), jnp.asarray(geo.nv_tx),
-            jnp.asarray(geo.snr), jnp.asarray(geo.eps),
-            jnp.asarray(geo.dly), jnp.uint32(seed),
-            jnp.asarray(geo.ndata_b))
+            *fused_args)
     status = np.asarray(status)
     mbps_sig = np.asarray(mbps_sig)
     len_sig = np.asarray(len_sig)
@@ -548,10 +553,12 @@ def sweep_ber(psdus, rates_mbps: Sequence[int],
     if _shard is not None:
         bits_d = _shard(bits_d)
     donate = jax.devices()[0].platform != "cpu"   # no-op (+warn) on CPU
+    sweep_fn = _jit_sweep_ber(rates_key, n_bytes, donate)
+    sweep_args = (bits_d, jnp.asarray(snr_flat),
+                  jnp.asarray(seed_flat), errbuf)
+    programs.note_site("link.sweep", sweep_fn, *sweep_args)
     with dispatch.timed("link.sweep"):
-        out = _jit_sweep_ber(rates_key, n_bytes, donate)(
-            bits_d, jnp.asarray(snr_flat),
-            jnp.asarray(seed_flat), errbuf)
+        out = sweep_fn(*sweep_args)
     # host pull outside the timed block (jaxlint R2): the site times
     # the dispatch, not the device wait
     errs = np.asarray(out, np.int64)

@@ -577,12 +577,14 @@ def _acquire_frame(samples, max_samples: int = 1 << 16):
     the per-capture acquisition front of `receive` — and the single-
     lane oracle of the batched `acquire_many`. Returns (RxResult,
     None) on any failure, (None, _Acquired) on success."""
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
     x, n_valid = _bucket_pad(
         np.asarray(samples, np.float32)[:max_samples])
+    sync_fn = _jit_sync_fn()
+    programs.note_site("rx.sync", sync_fn, x)
     with dispatch.timed("rx.sync"):
-        found, start, eps = _jit_sync_fn()(x)
+        found, start, eps = sync_fn(x)
     found = bool(np.asarray(found))
     start = int(np.asarray(start))
     eps = float(np.asarray(eps))
@@ -597,8 +599,10 @@ def _acquire_frame(samples, max_samples: int = 1 << 16):
         with dispatch.timed("rx.cfo_head"):
             head = sync.correct_cfo(jnp.asarray(x[start:start + 400]),
                                     eps)
+        sig_fn = _jit_signal_fn()
+        programs.note_site("rx.signal", sig_fn, head)
         with dispatch.timed("rx.signal"):
-            rb, ln, pk = _jit_signal_fn()(head)
+            rb, ln, pk = sig_fn(head)
         rate_bits = int(np.asarray(rb))
         length_bytes = int(np.asarray(ln))
         parity_ok = bool(np.asarray(pk))
@@ -668,12 +672,14 @@ def acquire_batch(x_dev, n_valid, limits, n_lanes: int):
     (results, lanes) as `acquire_many` does. This is the entry the
     device-resident loopback link uses — the TX/channel output feeds
     acquisition without ever crossing the host link."""
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
+    acq_fn = _jit_acquire_many()
+    acq_args = (x_dev, jnp.asarray(n_valid, jnp.int32),
+                jnp.asarray(limits, jnp.int32))
+    programs.note_site("rx.acquire_many", acq_fn, *acq_args)
     with dispatch.timed("rx.acquire_many"):
-        found_b, start_b, eps_b, rb_b, ln_b, pk_b = _jit_acquire_many()(
-            x_dev, jnp.asarray(n_valid, jnp.int32),
-            jnp.asarray(limits, jnp.int32))
+        found_b, start_b, eps_b, rb_b, ln_b, pk_b = acq_fn(*acq_args)
     found_b = np.asarray(found_b)
     start_b = np.asarray(start_b)
     eps_b = np.asarray(eps_b)
@@ -785,15 +791,18 @@ def gather_segments_many(x_dev, lanes, n_sym_bucket: int):
     `acquire_many`; output stays on device for the mixed-rate decode.
     `lanes` rows must already be padded to the target lane count
     (repeat the first entry, like every batch path here)."""
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
+    gather_fn = _jit_gather_segments(n_sym_bucket)
+    gather_args = (
+        x_dev,
+        jnp.asarray([la.row for la in lanes], jnp.int32),
+        jnp.asarray([la.start for la in lanes], jnp.int32),
+        jnp.asarray([la.eps for la in lanes], jnp.float32),
+        jnp.asarray([la.avail for la in lanes], jnp.int32))
+    programs.note_site("rx.gather", gather_fn, *gather_args)
     with dispatch.timed("rx.gather"):
-        return _jit_gather_segments(n_sym_bucket)(
-            x_dev,
-            jnp.asarray([la.row for la in lanes], jnp.int32),
-            jnp.asarray([la.start for la in lanes], jnp.int32),
-            jnp.asarray([la.eps for la in lanes], jnp.float32),
-            jnp.asarray([la.avail for la in lanes], jnp.int32))
+        return gather_fn(*gather_args)
 
 
 def _padded_segment(acq: _Acquired, n_sym_bucket: int):
@@ -996,7 +1005,9 @@ def receive(samples, check_fcs: bool = False,
         None if fxp else viterbi_metric,
         None if fxp else viterbi._check_radix(viterbi_radix),
         None if fxp else fused_demap_enabled(fused_demap))
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
+    programs.note_site("rx.decode_bucketed", dec, seg,
+                       jnp.int32(acq.n_sym * rate.n_dbps))
     # the host pull stays OUTSIDE the timed block: the site times the
     # dispatch, not the device wait (jaxlint R2 — docs/static_analysis.md)
     with dispatch.timed("rx.decode_bucketed"):

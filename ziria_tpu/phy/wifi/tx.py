@@ -323,14 +323,16 @@ def encode_many(psdus: Sequence, rates_mbps: Sequence[int],
     traffic mixes. The output stays device-resident — the loopback
     link (phy/link.py) feeds it straight into the channel and
     receiver without a host round trip."""
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
     prep = batch_host_prep(psdus, rates_mbps, add_fcs)
     n_valid = (400 + 80 * prep.n_sym).astype(np.int32)
+    enc_fn = _jit_encode_many(prep.bit_bucket, prep.n_sym_bucket)
+    enc_args = (jnp.asarray(prep.bits_b), jnp.asarray(prep.nbits_b),
+                jnp.asarray(prep.ridx_b))
+    programs.note_site("tx.encode_many", enc_fn, *enc_args)
     with dispatch.timed("tx.encode_many"):
-        samples = _jit_encode_many(prep.bit_bucket, prep.n_sym_bucket)(
-            jnp.asarray(prep.bits_b), jnp.asarray(prep.nbits_b),
-            jnp.asarray(prep.ridx_b))
+        samples = enc_fn(*enc_args)
     return TxBatch(samples, n_valid, prep.n_sym, tuple(rates_mbps),
                    prep.n_sym_bucket)
 
@@ -341,7 +343,7 @@ def encode_batch(psdus, rate_mbps: int,
     frame_len, 2) device-resident frames in ONE dispatch, sliced to
     the true frame length (every lane shares it). Bit-identical per
     lane to `encode_frame` — the TX side of the BER waterfall sweep."""
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
     from ziria_tpu.utils.dispatch import pow2_ceil
 
@@ -354,9 +356,11 @@ def encode_batch(psdus, rate_mbps: int,
     bits_b = np.zeros((pow2_ceil(n_frames), bb), np.uint8)
     bits_b[:n_frames, :n_bits] = bits
     bits_b[n_frames:] = bits_b[0]
+    enc_fn = _jit_encode_batch(rate_mbps, bb, _sym_bucket(n_sym))
+    enc_args = (jnp.asarray(bits_b), jnp.int32(n_bits))
+    programs.note_site("tx.encode_batch", enc_fn, *enc_args)
     with dispatch.timed("tx.encode_batch"):
-        out = _jit_encode_batch(rate_mbps, bb, _sym_bucket(n_sym))(
-            jnp.asarray(bits_b), jnp.int32(n_bits))
+        out = enc_fn(*enc_args)
     return out[:n_frames, :400 + 80 * n_sym]
 
 
@@ -376,7 +380,7 @@ def encode_frame(psdu_bytes, rate_mbps: int,
         if add_fcs:
             bits = append_crc32(bits)
         return encode_frame_bits(bits, rate)
-    from ziria_tpu.utils import dispatch
+    from ziria_tpu.utils import dispatch, programs
 
     bits = _host_psdu_bits(psdu_bytes, add_fcs)
     n_bits = bits.shape[0]
@@ -384,9 +388,11 @@ def encode_frame(psdu_bytes, rate_mbps: int,
     bb = _bit_bucket(n_bits)
     bits_pad = np.zeros(bb, np.uint8)
     bits_pad[:n_bits] = bits
+    enc_fn = _jit_encode_frame(rate_mbps, bb, _sym_bucket(n_sym))
+    enc_args = (jnp.asarray(bits_pad), jnp.int32(n_bits))
+    programs.note_site("tx.encode_frame", enc_fn, *enc_args)
     with dispatch.timed("tx.encode_frame"):
-        out = _jit_encode_frame(rate_mbps, bb, _sym_bucket(n_sym))(
-            jnp.asarray(bits_pad), jnp.int32(n_bits))
+        out = enc_fn(*enc_args)
     return out[:400 + 80 * n_sym]
 
 

@@ -131,9 +131,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="ziria_tpu",
         description="TPU-native stream pipeline driver "
                     "(reference-style params)",
-        epilog="subcommand: `python -m ziria_tpu lint [paths...]` runs "
+        epilog="subcommands: `python -m ziria_tpu lint [paths...]` runs "
                "the jaxlint static analysis (pure AST, no jax import; "
-               "docs/static_analysis.md)")
+               "docs/static_analysis.md); `python -m ziria_tpu programs "
+               "[--json] [--hlo-dump DIR]` runs the compiled-program "
+               "observatory (CPU-pinned XLA cost/memory attribution; "
+               "docs/observability.md)")
     p.add_argument("--prog", help="registered pipeline name")
     p.add_argument("--src", help="Ziria-like source file (.zir) to compile")
     p.add_argument("--list-progs", action="store_true")
@@ -693,6 +696,13 @@ def main(argv=None) -> int:
         # when the TPU backend probe hangs.
         from ziria_tpu.analysis.__main__ import main as lint_main
         return lint_main(argv[1:])
+    if argv and argv[0] == "programs":
+        # compiled-program observatory subcommand: XLA cost/memory
+        # attribution per jit factory. Dispatched BEFORE argparse,
+        # mirroring `lint`; the observatory pins the CPU backend
+        # itself, so cost attribution works while the TPU probe hangs.
+        from ziria_tpu.utils.programs import main as programs_main
+        return programs_main(argv[1:])
     args = build_parser().parse_args(argv)
     _apply_platform(args.platform)
     _apply_compile_cache(args.compile_cache)

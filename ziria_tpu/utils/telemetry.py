@@ -331,6 +331,7 @@ class Trace:
         self.annotate_device = annotate_device
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
+        self._meta: Dict[str, Any] = {}
         self._epoch = time.perf_counter()
         self._pid = os.getpid()
 
@@ -373,8 +374,20 @@ class Trace:
         with self._lock:
             return list(self._events)
 
+    def set_metadata(self, key: str, value: Any) -> None:
+        """Attach a top-level key to the exported trace object (the
+        Chrome trace format ignores unknown object keys, so riders
+        like the observatory's ``siteCosts`` travel with the events
+        and tools/trace_report.py can join on span labels)."""
+        with self._lock:
+            self._meta[key] = value
+
     def to_json(self) -> Dict[str, Any]:
-        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+        obj: Dict[str, Any] = {"traceEvents": self.events(),
+                               "displayTimeUnit": "ms"}
+        with self._lock:
+            obj.update(self._meta)
+        return obj
 
     def export(self, path: Optional[str] = None) -> Dict[str, Any]:
         """The trace as a Chrome trace-event JSON object; written to
