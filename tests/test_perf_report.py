@@ -283,6 +283,54 @@ def test_committed_trajectory_is_backfilled_and_loadable():
     # the last good TPU capture must be in the ledger
     assert any(r["platform"] == "tpu" and r["value"] > 1e8
                for r in recs)
+    # ... and so must the multichip dryrun history (ISSUE 11): five
+    # rounds of (n_devices, blocks_ok), the blocks monotone-growing
+    mc = [r for r in recs if r["stage"] == "multichip"]
+    assert len(mc) == 10, len(mc)
+    blocks = [r["value"] for r in sorted(
+        mc, key=lambda r: r["run_id"]) if r["metric"] == "blocks_ok"]
+    assert blocks == sorted(blocks) and blocks[0] == 3 \
+        and blocks[-1] == 7, blocks
+
+
+def test_backfill_multichip_family_is_one_shot(tmp_path):
+    # the ISSUE 11 satellite: MULTICHIP_r*.json artifacts land in the
+    # trajectory exactly once, even when the bench family was already
+    # backfilled by an earlier PR — and never twice
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "MULTICHIP_r01.json").write_text(json.dumps({
+        "n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+        # the "sp not ok" line must NOT count as a passed block (the
+        # " ok" substring trap), nor may prose mentioning "okay"
+        "tail": "dryrun_multichip(8): dp ok, decoded\n"
+                "dryrun_multichip(8): pp ok, pipeline\n"
+                "dryrun_multichip(8): sp not ok, halo failed\n"
+                "retrying is okay later\n"}))
+    (repo / "MULTICHIP_r02.json").write_text(json.dumps({
+        "n_devices": 8, "rc": 1, "ok": False, "skipped": True,
+        "tail": ""}))                       # skipped round: no record
+    traj = str(repo / "traj.jsonl")
+    # the bench family is already present (an earlier PR's backfill)
+    with open(traj, "w") as f:
+        f.write(json.dumps({
+            "run_id": "backfill:BENCH_r01", "unix": 1.0,
+            "stage": "result", "metric": "rx_sps", "value": 1e8,
+            "platform": "tpu", "partial": False,
+            "direction": "higher",
+            "source": "backfill:BENCH_r01.json"}) + "\n")
+    n, msg = pr.backfill(traj, repo=str(repo))
+    assert n == 2 and "bench already present" in msg
+    recs = [r for r in pr.load_trajectory(traj)
+            if r["stage"] == "multichip"]
+    assert {(r["metric"], r["value"]) for r in recs} == \
+        {("n_devices", 8), ("blocks_ok", 2)}
+    assert all(r["platform"] == "cpu"
+               and r["source"] == "backfill:MULTICHIP_r01.json"
+               for r in recs)
+    # second run refuses BOTH families
+    n2, msg2 = pr.backfill(traj, repo=str(repo))
+    assert n2 == 0 and "refusing" in msg2
 
 
 # ------------------------------------------------------- trace compare

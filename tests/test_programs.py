@@ -48,8 +48,10 @@ STREAM_DECODE_PIN = {"flops": 30006368.0, "bytes_accessed": 72476368.0}
 
 def _tier1_driver():
     """The cheap subset of programs.run_driver: per-frame receive,
-    batched receive (+CRC), and one streaming pass — 11 dispatch-site
-    labels, all at geometries other tier-1 suites also compile."""
+    batched receive (+CRC), one streaming pass, and one multi-stream
+    fleet pass — 13 dispatch-site labels, all at geometries other
+    tier-1 suites also compile (the fleet pass rides
+    test_rx_multistream's S=4 shape)."""
     from ziria_tpu.backend import framebatch
     from ziria_tpu.phy import link
     from ziria_tpu.phy.wifi import tx
@@ -74,6 +76,15 @@ def _tier1_driver():
                               frame_len=FRAME_LEN,
                               max_frames_per_chunk=K, check_fcs=True,
                               streaming=True)
+    streams, _st = link.stream_many_multi(
+        [psdus[:1], psdus[1:], [], psdus[:1]],
+        [rates[:1], rates[1:], [], rates[:1]],
+        snr_db=np.inf, cfo=1e-4, delay=60, seed=9, add_fcs=True,
+        tail=FRAME_LEN)
+    framebatch.receive_streams(streams, chunk_len=CHUNK,
+                               frame_len=FRAME_LEN,
+                               max_frames_per_chunk=K, check_fcs=True,
+                               multi=True)
 
 
 @pytest.fixture(scope="module")
@@ -107,6 +118,8 @@ def test_driver_covers_the_streaming_and_batched_factories(report):
     uncovered = set(report["uncovered"])
     for fq in ("ziria_tpu.phy.wifi.rx._jit_stream_chunk",
                "ziria_tpu.phy.wifi.rx._jit_stream_decode",
+               "ziria_tpu.phy.wifi.rx._jit_stream_chunk_multi",
+               "ziria_tpu.phy.wifi.rx._jit_stream_decode_multi",
                "ziria_tpu.phy.wifi.rx._jit_decode_data_mixed",
                "ziria_tpu.phy.wifi.rx._jit_acquire_many",
                "ziria_tpu.phy.wifi.rx._jit_sync_fn",
@@ -129,9 +142,11 @@ def test_factory_discovery_is_ast_driven():
     # the jit factories of the tree are found by the R1 convention —
     # and table/kernel lru_caches (no jit in the body) are NOT
     assert "ziria_tpu.phy.wifi.rx._jit_stream_chunk" in names
+    assert "ziria_tpu.phy.wifi.rx._jit_stream_chunk_multi" in names
+    assert "ziria_tpu.phy.wifi.rx._jit_stream_decode_multi" in names
     assert "ziria_tpu.phy.link._jit_fused_link" in names
     assert "ziria_tpu.ops.interleave.interleave_perm" not in names
-    assert len(facs) >= 16
+    assert len(facs) >= 18
 
 
 # ------------------------------------------------------------- cost pins
@@ -182,6 +197,7 @@ def test_note_site_is_free_when_idle():
 def test_site_costs_join_on_dispatch_labels(report):
     labels = {r["label"] for r in report["programs"]}
     for lbl in ("rx.stream_chunk", "rx.stream_decode",
+                "rx.stream_chunk_multi", "rx.stream_decode_multi",
                 "rx.decode_mixed", "rx.crc_many", "rx.acquire_many",
                 "tx.encode_many"):
         assert lbl in labels, sorted(labels)

@@ -1,0 +1,352 @@
+"""Multi-stream fleet receiver (backend/framebatch.receive_streams +
+MultiStreamReceiver + rx._jit_stream_chunk_multi/_jit_stream_decode_multi):
+S concurrent I/Q streams' chunks stacked on a leading stream axis
+through stream-axis-vmapped twins of the two compiled streaming
+programs — <= 2 device dispatches per CHUNK-STEP independent of S —
+with every emitted frame bit-identical, lane for lane and RxResult
+field for field, to S independent single-stream `StreamReceiver`s
+(and hence, transitively, to per-capture `rx.receive` over the
+slice — the PR 5 contract).
+
+Budget discipline (the tier-1 870 s cutoff is real): ONE module
+fixture pays the S=8 fleet compiles at the suite-shared streaming
+geometry (chunk 4096, window 1024, K=8, 8-symbol bucket — the same
+keys test_rx_stream and test_programs share), covering mixed rates
+(all 8 across the fleet), a chunk-boundary-straddling frame, an
+all-noise stream, an EMPTY stream, and ragged lengths. The sharded
+run (frame_mesh(8), one stream per virtual device) and the S=1 pin
+compile their own (small) programs; everything else re-dispatches.
+"""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend import framebatch
+from ziria_tpu.phy import link
+from ziria_tpu.phy.wifi import rx
+from ziria_tpu.utils import dispatch
+
+N_BYTES = 12     # +4 FCS = the suite's standard 16-byte on-air PSDU
+CHUNK, FRAME_LEN, K, S = 4096, 1024, 8, 8
+GEO = dict(chunk_len=CHUNK, frame_len=FRAME_LEN,
+           max_frames_per_chunk=K, check_fcs=True)
+
+
+def _same_result(a, b) -> bool:
+    return (a.ok == b.ok and a.rate_mbps == b.rate_mbps
+            and a.length_bytes == b.length_bytes
+            and np.array_equal(a.psdu_bits, b.psdu_bits)
+            and a.crc_ok == b.crc_ok)
+
+
+def _same_frames(got, want) -> None:
+    assert [f.start for f in got] == [f.start for f in want]
+    for a, b in zip(got, want):
+        assert _same_result(a.result, b.result)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """An 8-stream fleet load: all 8 rates spread across the streams,
+    one stream whose second frame straddles its chunk boundary, one
+    all-noise stream, one EMPTY stream, ragged lengths — plus one
+    fleet pass and one S-independent-receivers oracle pass, both
+    under dispatch counters."""
+    rng = np.random.default_rng(20260803)
+
+    def psdus(n):
+        return [rng.integers(0, 256, N_BYTES).astype(np.uint8)
+                for _ in range(n)]
+
+    per_psdus = [psdus(2), psdus(2), [], psdus(3), psdus(2),
+                 psdus(1), [], psdus(2)]
+    per_rates = [[6, 54], [54, 54], [], [24, 36, 48], [9, 12],
+                 [18], [], [48, 6]]
+    per_gaps = [None, [3260], None, None, None, None, None, None]
+    per_delay = [60, 60, 0, 500, 1500, 30, 0, 100]
+    streams, starts = [], []
+    for i in range(S):
+        if not per_psdus[i]:
+            streams.append(np.zeros((0, 2), np.float32))
+            starts.append(np.zeros((0,), np.int64))
+            continue
+        st, sts = link.stream_many(
+            per_psdus[i], per_rates[i], gaps=per_gaps[i],
+            snr_db=30.0, cfo=1e-4, delay=per_delay[i],
+            seed=40 + i, add_fcs=True, tail=FRAME_LEN)
+        streams.append(st)
+        starts.append(sts)
+    # stream 2: noise, no frames (long enough to own a full chunk)
+    streams[2] = rng.normal(scale=0.05, size=(CHUNK + 2000, 2)) \
+        .astype(np.float32)
+    # the straddle stream really straddles: frame 1 starts inside
+    # chunk 0's overlap and crosses the 4096 boundary (the
+    # test_rx_stream recipe, here as ONE lane of the fleet)
+    assert starts[1][1] == 3800 and starts[1][1] + 480 > CHUNK
+
+    with dispatch.count_dispatches() as d_m:
+        res_m, st_m = framebatch.receive_streams(streams, multi=True,
+                                                 **GEO)
+    with dispatch.count_dispatches() as d_o:
+        res_o, st_o = framebatch.receive_streams(streams, multi=False,
+                                                 **GEO)
+    return streams, starts, res_m, st_m, d_m, res_o, st_o, d_o
+
+
+def test_fleet_bit_identical_to_s_independent_receivers(corpus):
+    # THE fleet contract: per stream, frame for frame, every emitted
+    # start and RxResult (crc_ok included) equals what a lone
+    # single-stream receiver emits — mixed rates, straddle, noise,
+    # empty, and ragged lengths all riding one stream axis
+    streams, starts, res_m, _st, _d, res_o, _so, _do = corpus
+    assert len(res_m) == len(res_o) == S
+    for i in range(S):
+        _same_frames(res_m[i], res_o[i])
+        assert [f.start for f in res_m[i]] == list(starts[i])
+    # all 8 rates decoded somewhere in the fleet
+    got_rates = sorted(f.result.rate_mbps
+                       for r in res_m for f in r if f.result.ok)
+    assert set(got_rates) == {6, 9, 12, 18, 24, 36, 48, 54}
+    # noise and empty streams emit nothing, in both paths
+    assert res_m[2] == [] and res_m[6] == []
+
+
+def test_straddling_frame_decoded_exactly_once_in_fleet(corpus):
+    streams, starts, res_m, _st, _d, _ro, _so, _do = corpus
+    assert [f.start for f in res_m[1]] == list(starts[1])
+    for f in res_m[1]:
+        assert f.result.ok and f.result.crc_ok
+        ref = rx.receive(streams[1][f.start: f.start + FRAME_LEN],
+                         check_fcs=True)
+        assert _same_result(f.result, ref)
+
+
+def test_dispatches_per_chunk_step_independent_of_s(corpus):
+    # the tentpole number at S=8: <= 2 dispatches per CHUNK-STEP
+    # (one stacked scan + at most one flattened decode), however many
+    # streams ride the step — vs the oracle's per-stream chunk costs
+    _s, _starts, _rm, st_m, d_m, _ro, st_o, d_o = corpus
+    assert st_m.streams == S and st_m.chunk_steps >= 2
+    assert d_m.total <= 2 * st_m.chunk_steps, dict(d_m.counts)
+    assert d_m.counts["rx.stream_chunk_multi"] == st_m.chunk_steps
+    assert d_m.counts["rx.stream_decode_multi"] <= st_m.chunk_steps
+    # the oracle pays one scan per PER-STREAM chunk: strictly more
+    # scans than the fleet's chunk-steps (7 non-empty streams)
+    assert d_o.counts["rx.stream_chunk"] == st_o.chunk_steps
+    assert st_o.chunk_steps > st_m.chunk_steps
+    assert st_m.frames == st_o.frames
+    # double-buffering still overlaps at fleet scale
+    assert d_m.gauges["rx.stream_inflight"] == 2
+    assert st_m.max_in_flight == 2
+    assert st_m.overflow_chunks == 0
+
+
+def test_active_streams_gauge_and_per_stream_carry_rows(corpus):
+    # the telemetry satellite: the fleet records an rx.active_streams
+    # level per chunk-step (aggregate row) plus per-stream carry-depth
+    # labels (the per-stream rows trace_report renders alongside)
+    _s, _starts, _rm, st_m, d_m, _ro, _so, _do = corpus
+    assert d_m.gauges["rx.active_streams"] == st_m.max_active_streams
+    assert 2 <= st_m.max_active_streams <= S
+    assert "rx.stream_carry_depth" in d_m.gauges
+    per = [k for k in d_m.gauges
+           if k.startswith("rx.stream_carry_depth[s")]
+    assert per, sorted(d_m.gauges)
+    # the empty stream never rides a step, so it has no carry row
+    assert "rx.stream_carry_depth[s6]" not in d_m.gauges
+
+
+def test_dispatch_pin_at_s1(corpus):
+    # S=1 is the degenerate fleet: same <= 2-per-chunk-step pin, and
+    # bit-identity with the single-stream receiver it wraps
+    streams, _starts, _rm, _st, _d, res_o, _so, _do = corpus
+    with dispatch.count_dispatches() as d1:
+        res_1, st_1 = framebatch.receive_streams(streams[:1],
+                                                 multi=True, **GEO)
+    assert st_1.streams == 1 and st_1.chunk_steps >= 1
+    assert d1.total <= 2 * st_1.chunk_steps, dict(d1.counts)
+    _same_frames(res_1[0], res_o[0])
+
+
+def test_sharded_fleet_on_suite_mesh_bit_identical(corpus):
+    # the dp-mesh path: the SAME fleet with its stream axis sharded
+    # over the suite's 8 virtual devices (one stream per device,
+    # shard_map via the compat shim) — identical per-device program,
+    # streams independent, so results are bit-identical lane for lane
+    # and the dispatch pin is unchanged
+    from ziria_tpu.parallel.batch import frame_mesh
+
+    streams, starts, res_m, _st, _d, _ro, _so, _do = corpus
+    mesh = frame_mesh(8)
+    with dispatch.count_dispatches() as d_sh:
+        res_s, st_s = framebatch.receive_streams(
+            streams, multi=True, mesh=mesh, **GEO)
+    assert d_sh.total <= 2 * st_s.chunk_steps, dict(d_sh.counts)
+    for i in range(S):
+        _same_frames(res_s[i], res_m[i])
+        assert [f.start for f in res_s[i]] == list(starts[i])
+
+
+def test_all_noise_fleet_costs_one_dispatch_per_step(corpus):
+    # the noise fast path survives the fleet: a chunk-step with zero
+    # decodable lanes across ALL streams skips the decode dispatch
+    # entirely (geometry shared with the fixture: zero new compiles)
+    rng = np.random.default_rng(31)
+    noise = [rng.normal(scale=0.05, size=(2 * CHUNK, 2))
+             .astype(np.float32) for _ in range(S)]
+    with dispatch.count_dispatches() as d:
+        res, stats = framebatch.receive_streams(noise, multi=True,
+                                                **GEO)
+    assert all(r == [] for r in res)
+    assert stats.frames == 0 and stats.overflow_chunks == 0
+    assert d.total == stats.chunk_steps
+    assert d.counts.get("rx.stream_decode_multi", 0) == 0
+
+
+def test_ragged_pushes_thread_carries_no_recompile(corpus):
+    """The push-driven fleet surface: the same 8 streams fed in
+    ragged per-stream slabs through ONE MultiStreamReceiver emit the
+    same frames as the one-shot call, per-stream (tail, offset,
+    emitted, watermark) carries threading across chunk-steps. The
+    whole steady state runs under dispatch.no_recompile: at the
+    fixture's already-compiled geometry, ragged arrival may only
+    RE-DISPATCH the two compiled fleet programs."""
+    streams, _starts, res_m, _st, _d, _ro, _so, _do = corpus
+    with dispatch.no_recompile(rx._jit_stream_chunk_multi,
+                               rx._jit_stream_decode_multi):
+        msr = framebatch.MultiStreamReceiver(S, **GEO)
+        got = []
+        for a, b in [(0, 500), (500, 3500), (3500, 4200),
+                     (4200, 7000), (7000, None)]:
+            for i in range(S):
+                got += msr.push(i, streams[i][a:b])
+        got += msr.flush()
+    per = [[] for _ in range(S)]
+    for i, fr in got:
+        per[i].append(fr)
+    for i in range(S):
+        _same_frames(per[i], res_m[i])
+        c = msr.carry(i)
+        assert c.offset + c.tail.shape[0] == streams[i].shape[0]
+        assert c.emitted == len(res_m[i])
+    # the dedupe watermark is per stream: streams that drained a
+    # chunk-step carry the prune bound forward
+    assert msr.carry(1).watermark > 0
+    assert msr.carry(6).watermark == 0          # empty stream
+    with pytest.raises(RuntimeError):
+        msr.push(0, streams[0][:8])             # closed fleet
+    with pytest.raises(RuntimeError):
+        msr.push_many([s[:0] for s in streams])
+
+
+def test_single_stream_carry_exposes_watermark(corpus):
+    # the StreamCarry watermark satellite reaches the single-stream
+    # receiver too (same fixture geometry: re-dispatch only)
+    streams, _starts, _rm, _st, _d, _ro, _so, _do = corpus
+    sr = framebatch.StreamReceiver(**GEO)
+    sr.push(streams[1])
+    sr.flush()
+    assert sr.carry.watermark > 0
+    assert sr.carry.emitted == 2
+
+
+def test_multi_stream_env_knob(monkeypatch):
+    # the CLI's scoped-env pattern: default ON, ZIRIA_MULTI_STREAM=0
+    # forces the S-independent-receivers oracle, an explicit argument
+    # wins; any nonzero lane count means ON
+    monkeypatch.delenv("ZIRIA_MULTI_STREAM", raising=False)
+    assert framebatch.multi_stream_enabled(None)
+    monkeypatch.setenv("ZIRIA_MULTI_STREAM", "0")
+    assert not framebatch.multi_stream_enabled(None)
+    assert framebatch.multi_stream_enabled(True)
+    monkeypatch.setenv("ZIRIA_MULTI_STREAM", "8")
+    assert framebatch.multi_stream_enabled(None)
+    assert not framebatch.multi_stream_enabled(False)
+
+
+def test_cli_multi_stream_flag_scopes_env(tmp_path, monkeypatch):
+    """--multi-stream S writes ZIRIA_MULTI_STREAM for the invocation
+    only (the scoped-env pattern): a pre-existing value is restored
+    after main() returns, and --no-multi-stream maps to the "0"
+    force-off value."""
+    import os
+
+    from ziria_tpu.runtime.buffers import StreamSpec, write_stream
+    from ziria_tpu.runtime.cli import build_parser, main as cli_main
+
+    args = build_parser().parse_args(["--multi-stream", "4"])
+    assert args.multi_stream == 4
+    args = build_parser().parse_args(["--no-multi-stream"])
+    assert args.multi_stream == 0
+
+    inf, outf = tmp_path / "in.dbg", tmp_path / "out.dbg"
+    rng = np.random.default_rng(0)
+    write_stream(StreamSpec(ty="bit", path=str(inf), mode="dbg"),
+                 rng.integers(0, 2, 16).astype(np.uint8))
+    monkeypatch.setenv("ZIRIA_MULTI_STREAM", "0")
+    rc = cli_main([
+        "--prog=scramble",
+        "--input=file", f"--input-file-name={inf}",
+        "--input-file-mode=dbg", "--input-type=bit",
+        "--output=file", f"--output-file-name={outf}",
+        "--output-file-mode=dbg", "--output-type=bit",
+        "--backend=interp", "--multi-stream", "4",
+    ])
+    assert rc == 0
+    assert os.environ.get("ZIRIA_MULTI_STREAM") == "0"   # restored
+
+
+def test_bad_geometry_and_mesh_divisibility_rejected():
+    with pytest.raises(ValueError):
+        framebatch.MultiStreamReceiver(0, **GEO)
+    with pytest.raises(ValueError):
+        framebatch.MultiStreamReceiver(2, chunk_len=4096,
+                                       frame_len=1000)
+    with pytest.raises(ValueError):
+        framebatch.MultiStreamReceiver(2, chunk_len=1024,
+                                       frame_len=1024)
+    from ziria_tpu.parallel.batch import frame_mesh
+    with pytest.raises(ValueError):
+        framebatch.MultiStreamReceiver(5, mesh=frame_mesh(8), **GEO)
+    # a mesh cannot ride the S-independent-receivers oracle: loud, not
+    # a silently unsharded measurement
+    with pytest.raises(ValueError):
+        framebatch.receive_streams(
+            [np.zeros((8, 2), np.float32)], multi=False,
+            mesh=frame_mesh(8), **GEO)
+    msr = framebatch.MultiStreamReceiver(2, **GEO)
+    with pytest.raises(IndexError):
+        msr.push(2, np.zeros((4, 2), np.float32))
+    with pytest.raises(ValueError):
+        msr.push_many([np.zeros((4, 2), np.float32)])
+    per, stats = framebatch.receive_streams([], **GEO)
+    assert per == [] and stats.streams == 0
+
+
+def test_stream_many_multi_synthesizer_contract():
+    # per-stream folded seeds: independent reproducible lanes, no
+    # aliasing of the base seed; broadcast per-stream channel params;
+    # shape errors loud
+    rng = np.random.default_rng(7)
+    pp = [[rng.integers(0, 256, N_BYTES).astype(np.uint8)],
+          [rng.integers(0, 256, N_BYTES).astype(np.uint8)]]
+    streams, starts = link.stream_many_multi(
+        pp, [[6], [54]], snr_db=30.0, cfo=[1e-4, -1e-4],
+        delay=[60, 90], seed=3, add_fcs=True, tail=FRAME_LEN)
+    assert len(streams) == len(starts) == 2
+    assert starts[0][0] == 60 and starts[1][0] == 90
+    # deterministic: the same call reproduces bit-identical streams
+    streams2, _ = link.stream_many_multi(
+        pp, [[6], [54]], snr_db=30.0, cfo=[1e-4, -1e-4],
+        delay=[60, 90], seed=3, add_fcs=True, tail=FRAME_LEN)
+    assert all(np.array_equal(a, b)
+               for a, b in zip(streams, streams2))
+    # stream i's draws differ from the base-seed single-stream call
+    solo, _ = link.stream_many(pp[0], [6], snr_db=30.0, cfo=1e-4,
+                               delay=60, seed=3, add_fcs=True,
+                               tail=FRAME_LEN)
+    assert not np.array_equal(streams[0], solo)
+    with pytest.raises(ValueError):
+        link.stream_many_multi(pp, [[6]])
+    with pytest.raises(ValueError):
+        link.stream_many_multi(pp, [[6], [54]], gaps=[[1]])

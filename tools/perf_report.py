@@ -17,11 +17,14 @@ stage (`bench.py` appends them; schema below). This tool reads it:
   beyond tolerance — the precommit/CI gate (tools/precommit.sh).
   Fewer than two comparable runs exits 0 with a note: an empty ledger
   must not block a commit.
-- **--backfill**: one-time import of the pre-ledger history — the
-  BENCH_r01..r05 artifacts (whose metric JSON is trapped inside a
-  ``"tail"`` stderr string), BASELINE.json's pinned baseline, and
-  BENCH_LIVE.json — so the trajectory starts with every number the
-  repo ever published. Refuses to run twice (records carry
+- **--backfill**: one-time import of the pre-ledger history, per
+  FAMILY — the BENCH_r01..r05 artifacts (whose metric JSON is
+  trapped inside a ``"tail"`` stderr string), BASELINE.json's pinned
+  baseline, and BENCH_LIVE.json; plus the MULTICHIP_r01..r05 dryrun
+  artifacts (device count + passed parallel-mode blocks per round,
+  stage ``multichip``) — so the trajectory starts with every number
+  the repo ever published, multichip scaling history included. Each
+  family refuses to run twice (records carry
   ``source: backfill:*``).
 
 Record schema (one JSON object per line):
@@ -44,6 +47,7 @@ import calendar
 import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -319,18 +323,86 @@ def backfill_records(repo=REPO):
     return out
 
 
+def multichip_backfill_records(repo=REPO):
+    """The committed MULTICHIP_r01..r05.json dryrun artifacts as
+    trajectory records (stage ``multichip``): per round, the device
+    count the dryrun ran on and the number of parallel-mode blocks
+    that passed (the ``... ok,`` lines in the tail — dp, pp, sp and
+    their products; 3 blocks in r01 grew to 7 by r05, the scaling
+    history the new multi_stream stage extends). The dryruns execute
+    on virtual CPU devices (``__graft_entry__.dryrun_multichip`` pins
+    the platform), so the records carry ``platform: cpu``. Undated
+    artifacts get the same tiny ordinal stamps as the bench family."""
+    out = []
+    seq = [0]
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "MULTICHIP_r0*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if art.get("skipped"):
+            continue
+        seq[0] += 1
+        rid = f"backfill:{name[:-5]}"
+        # a passed block is a "<mode> ok, ..." line; require the word
+        # (not a substring — "sp not ok" must not count) and refuse
+        # negated forms a future partly-failing round might print
+        blocks_ok = len([ln for ln in str(art.get("tail", ""))
+                         .splitlines()
+                         if re.search(r"\bok\b", ln)
+                         and not re.search(r"\bnot ok\b", ln)])
+        for metric, value in (("n_devices", art.get("n_devices")),
+                              ("blocks_ok", blocks_ok)):
+            if value is None:
+                continue
+            out.append({"run_id": rid, "unix": float(seq[0]),
+                        "stage": "multichip", "metric": metric,
+                        "value": value, "platform": "cpu",
+                        "partial": not art.get("ok", False),
+                        "direction": "higher",
+                        "source": f"backfill:{name}"})
+    return out
+
+
 def backfill(path, repo=REPO):
-    """Append the backfill records once. Returns (count, message);
-    refuses when the trajectory already holds backfill records."""
-    for rec in load_trajectory(path):
-        if str(rec.get("source", "")).startswith("backfill:"):
-            return 0, "trajectory already backfilled — refusing to " \
-                      "duplicate history"
-    recs = backfill_records(repo)
+    """Append the backfill records once PER FAMILY. Two independent
+    one-shot families share the refuse-twice discipline: the bench
+    history (BENCH_r*.json tails + BASELINE + BENCH_LIVE) and the
+    multichip dryrun history (MULTICHIP_r*.json) — a trajectory that
+    already holds a family's ``backfill:*`` records never gets that
+    family again, but a later PR adding a NEW family (as ISSUE 11 did
+    with multichip) can still land it exactly once. Returns
+    (count, message)."""
+    have = {str(rec.get("source", ""))
+            for rec in load_trajectory(path)}
+    # families are POSITIVELY identified by their source prefixes — a
+    # future third family's records must never suppress these two
+    done_bench = any(s.startswith(("backfill:BENCH",
+                                   "backfill:BASELINE"))
+                     and not s.startswith("backfill:MULTICHIP")
+                     for s in have)
+    done_multichip = any(s.startswith("backfill:MULTICHIP")
+                         for s in have)
+    recs = []
+    if not done_bench:
+        recs += backfill_records(repo)
+    if not done_multichip:
+        recs += multichip_backfill_records(repo)
+    if not recs:
+        return 0, "trajectory already backfilled (bench + multichip " \
+                  "families) — refusing to duplicate history"
     with open(path, "a") as f:
         for rec in recs:
             f.write(json.dumps(rec) + "\n")
-    return len(recs), f"backfilled {len(recs)} record(s) into {path}"
+    skipped = [n for n, d in (("bench", done_bench),
+                              ("multichip", done_multichip)) if d]
+    msg = f"backfilled {len(recs)} record(s) into {path}"
+    if skipped:
+        msg += f" ({', '.join(skipped)} already present — skipped)"
+    return len(recs), msg
 
 
 # ---------------------------------------------------------------- CLI

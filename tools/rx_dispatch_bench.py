@@ -84,6 +84,15 @@ smoke or a manual chip window:
   trace embeds the ``siteCosts``/``devicePeaks`` riders so
   trace_report prints GB/s per span label.
 
+- ``multi_stream_stats`` (ISSUE 11 tentpole): S concurrent streams
+  through the stream-axis fleet receiver
+  (``framebatch.receive_streams``) vs S independent single-stream
+  receivers — <= 2 dispatches per CHUNK-STEP independent of S
+  (asserted), lane-for-lane bit-identity per stream, aggregate
+  samples/s per dp mesh size (``sps_by_devices`` — the scaling
+  record the ROADMAP's "many streams, one device fleet" item asks
+  for), active-streams gauge, latency + roofline blocks.
+
 Standalone: ``ZIRIA_TOOL_ALLOW_CPU=1 python tools/rx_dispatch_bench.py``
 runs all at shrunk sizes on CPU (results labelled platform=cpu,
 never mistakable for chip evidence). Emits ONE JSON object.
@@ -657,6 +666,148 @@ def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
     }
 
 
+def multi_stream_stats(n_streams=8, frames_per_stream=4, n_bytes=12,
+                       snr_db=30.0, chunk_len=4096, frame_len=1024,
+                       k=8, mesh_sizes=None):
+    """S concurrent I/Q streams through the stream-axis fleet receiver
+    (``framebatch.receive_streams`` + ``MultiStreamReceiver``) vs S
+    independent single-stream receivers (the oracle): dispatch counts
+    per chunk-step (<= 2 *independent of S* — asserted), aggregate
+    samples/s, the active-streams gauge, lane-for-lane bit-identity
+    per stream (results AND starts vs the synthesizer's ground
+    truth), per-site latency distributions and roofline blocks, and
+    — the scaling record — aggregate samples/s per dp mesh size
+    (``sps_by_devices``: the unsharded run is the 1-device point,
+    then ``frame_mesh(n)``-sharded fleets for every usable n in
+    ``mesh_sizes``; identical per-device program, streams
+    independent, so the sharded results are gated bit-identical
+    too). Returns a flat dict."""
+    import jax
+
+    from ziria_tpu.backend import framebatch
+    from ziria_tpu.parallel import batch as pbatch
+    from ziria_tpu.phy import link
+    from ziria_tpu.phy.wifi.params import RATES
+    from ziria_tpu.utils import programs, telemetry
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    rng = np.random.default_rng(23)
+    rates_all = sorted(RATES)
+    psdus_per, rates_per = [], []
+    for i in range(n_streams):
+        rates = [rates_all[(i + j) % len(rates_all)]
+                 for j in range(frames_per_stream)]
+        rates_per.append(rates)
+        psdus_per.append([rng.integers(0, 256, n_bytes)
+                          .astype(np.uint8) for _ in rates])
+    streams, starts = link.stream_many_multi(
+        psdus_per, rates_per, snr_db=snr_db, cfo=1e-4, delay=60,
+        seed=9, add_fcs=True, tail=frame_len)
+    kw = dict(chunk_len=chunk_len, frame_len=frame_len,
+              max_frames_per_chunk=k, check_fcs=True)
+    n_samples = sum(int(s.shape[0]) for s in streams)
+
+    def gate(res_a, res_b, what):
+        assert [len(r) for r in res_a] == [len(r) for r in res_b], what
+        for i in range(n_streams):
+            assert [f.start for f in res_a[i]] == list(starts[i]), \
+                f"{what}: stream {i} starts diverged from ground truth"
+            for a, b in zip(res_a[i], res_b[i]):
+                assert (a.start == b.start
+                        and a.result.ok == b.result.ok
+                        and a.result.crc_ok == b.result.crc_ok
+                        and a.result.rate_mbps == b.result.rate_mbps
+                        and a.result.length_bytes == b.result.length_bytes
+                        and np.array_equal(a.result.psdu_bits,
+                                           b.result.psdu_bits)), \
+                    f"{what}: stream {i} diverged lane for lane"
+
+    with programs.observing() as obs:
+        with telemetry.collect() as reg_or:
+            with count_dispatches() as d_or:
+                res_o, st_o = framebatch.receive_streams(
+                    streams, multi=False, **kw)
+            t_or = _timed(lambda: framebatch.receive_streams(
+                streams, multi=False, **kw))
+
+        with telemetry.collect() as reg_ml:
+            with count_dispatches() as d_ml:
+                res_m, st_m = framebatch.receive_streams(
+                    streams, multi=True, **kw)
+            t_ml = _timed(lambda: framebatch.receive_streams(
+                streams, multi=True, **kw))
+
+    gate(res_m, res_o, "fleet vs S independent receivers")
+    assert all(f.result.ok and f.result.crc_ok
+               for r in res_m for f in r), \
+        "a stimulus frame failed to decode (identically in both paths)"
+    # the tentpole pin: <= 2 dispatches per chunk-step, S-free
+    assert d_ml.total <= 2 * st_m.chunk_steps, \
+        (dict(d_ml.counts), st_m)
+
+    # aggregate samples/s per device count: the unsharded fleet is the
+    # 1-device point; each usable mesh size reruns the SAME fleet with
+    # the stream axis sharded over frame_mesh(n) and gates identity
+    sps_by_devices = {"1": round(n_samples / t_ml, 1)}
+    devs = jax.devices()
+    if mesh_sizes is None:
+        # the largest mesh the fleet can shard evenly over — on the
+        # 8-virtual-device CPU box that is 8 for S=8 and 4 for the
+        # smoke's S=4 (never silently no mesh point at all)
+        usable = [n for n in range(2, len(devs) + 1)
+                  if n_streams % n == 0]
+        sizes = [max(usable)] if usable else []
+    else:
+        sizes = sorted(set(mesh_sizes))
+    for n in sizes:
+        if n <= 1 or n > len(devs) or n_streams % n:
+            continue
+        mesh = pbatch.frame_mesh(n)
+        res_s, _st_s = framebatch.receive_streams(
+            streams, multi=True, mesh=mesh, **kw)
+        gate(res_s, res_m, f"sharded fleet (dp={n})")
+        t_n = _timed(lambda _m=mesh: framebatch.receive_streams(
+            streams, multi=True, mesh=_m, **kw))
+        sps_by_devices[str(n)] = round(n_samples / t_n, 1)
+
+    out = {
+        "streams": n_streams, "frames_per_stream": frames_per_stream,
+        "frame_bytes": n_bytes, "snr_db": snr_db,
+        "stream_samples_total": n_samples,
+        "chunk_steps": st_m.chunk_steps,
+        "chunk_len": chunk_len, "frame_len": frame_len,
+        "dispatches_oracle": d_or.total,
+        "dispatches_multi": d_ml.total,
+        "dispatch_breakdown_multi": dict(d_ml.counts),
+        "dispatch_times_ms_multi": d_ml.times_ms(),
+        "dispatch_times_ms_oracle": d_or.times_ms(),
+        # the S-independence record, machine-checkable: dispatches per
+        # chunk-step for THIS S (pinned <= 2 above)
+        "dispatches_per_chunk_step": round(
+            d_ml.total / max(st_m.chunk_steps, 1), 3),
+        "max_active_streams": st_m.max_active_streams,
+        "max_in_flight": st_m.max_in_flight,
+        "overflow_chunks": st_m.overflow_chunks,
+        "latency_ms_multi": _latency_block(reg_ml),
+        "latency_ms_oracle": _latency_block(reg_or),
+        "roofline_by_site": _roofline_by_site(
+            obs, [_latency_block(reg_or), _latency_block(reg_ml)],
+            _device_kind()),
+        "t_oracle_s": round(t_or, 4),
+        "t_multi_s": round(t_ml, 4),
+        "sps_oracle": round(n_samples / t_or, 1),
+        "sps_multi": round(n_samples / t_ml, 1),
+        "sps_by_devices": sps_by_devices,
+        "bit_identical": True,
+    }
+    ks = sorted(sps_by_devices, key=int)
+    if len(ks) > 1:
+        out["mesh_scaling"] = round(
+            sps_by_devices[ks[-1]] / max(sps_by_devices["1"], 1e-9), 3)
+        out["mesh_devices_max"] = int(ks[-1])
+    return out
+
+
 def viterbi_breakdown(B=128, n_bytes=1000, rate_mbps=54, k1=4, k2=12):
     """ACS-only vs traceback-only vs front-end-only vs full decode at
     the bench shape — the answer to bench.py's open question ("the
@@ -900,9 +1051,49 @@ def viterbi_kernel_stats(B=128, n_bytes=1000, rate_mbps=54,
     return out
 
 
+def _multi_stream_mesh_main(argv):
+    """``rx_dispatch_bench.py --multi-stream-mesh N [S]``: the mesh
+    point of `multi_stream_stats` alone, in a process whose caller
+    exported ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+    (virtual devices must exist BEFORE jax initializes — the
+    `dryrun_multichip` mechanism). bench.py's multi_stream stage
+    spawns this when its own process sees a single device, so the
+    CPU smoke child still records aggregate samples/s vs mesh size.
+    Prints ONE JSON object with `sps_by_devices`/`mesh_scaling`."""
+    import jax
+
+    n = int(argv[0]) if argv else 4
+    n_streams = int(argv[1]) if len(argv) > 1 else n
+    if os.environ.get("ZIRIA_TOOL_ALLOW_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    try:   # persistent cache: the probe's compiles are bench compiles
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:
+        pass
+    if len(jax.devices()) < n:
+        print(json.dumps({"error": f"{len(jax.devices())} device(s) "
+                          f"visible, need {n} (export XLA_FLAGS="
+                          f"--xla_force_host_platform_device_count="
+                          f"{n})"}))
+        return 1
+    out = multi_stream_stats(n_streams=n_streams, frames_per_stream=2,
+                             mesh_sizes=[n])
+    print(json.dumps({k: out[k] for k in
+                      ("streams", "sps_by_devices", "mesh_scaling",
+                       "mesh_devices_max", "bit_identical",
+                       "dispatches_per_chunk_step") if k in out}))
+    return 0
+
+
 def main():
     import jax
 
+    if sys.argv[1:2] == ["--multi-stream-mesh"]:
+        return _multi_stream_mesh_main(sys.argv[2:])
     smoke = os.environ.get("ZIRIA_TOOL_ALLOW_CPU") == "1"
     if smoke:
         jax.config.update("jax_platforms", "cpu")
@@ -929,6 +1120,8 @@ def main():
         out["ber_sweep"] = ber_sweep_stats(
             n_frames=8, n_bytes=24, rates=(6, 54), snrs=(3.0, 8.0))
         out["streaming_rx"] = streaming_stats(n_frames=8)
+        out["multi_stream"] = multi_stream_stats(
+            n_streams=4, frames_per_stream=2)
     else:
         out["quantized"] = quantized_sweep()
         out["viterbi_breakdown"] = viterbi_breakdown()
@@ -941,6 +1134,7 @@ def main():
         out["fused_link"] = fused_link_stats()
         out["ber_sweep"] = ber_sweep_stats()
         out["streaming_rx"] = streaming_stats()
+        out["multi_stream"] = multi_stream_stats()
     print(json.dumps(out))
     return 0
 

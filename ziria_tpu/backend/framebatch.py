@@ -415,14 +415,42 @@ class StreamFrame(NamedTuple):
 class StreamCarry(NamedTuple):
     """The cross-chunk carry the receiver threads internally: the
     not-yet-owned tail samples, the stream coordinate of their first
-    sample, and the frames emitted so far. Exposed read-only via
-    :attr:`StreamReceiver.carry` for observability and tests — to
-    continue a stream across slabs, keep pushing into the SAME
-    receiver (the carry is its live state, not a detached resume
+    sample, the frames emitted so far, and the dedupe watermark (the
+    offset below which no future chunk can re-own a start — the
+    `_seen` set holds only entries at or above it, O(K) per stream).
+    Exposed read-only via :attr:`StreamReceiver.carry` (and per lane
+    via :meth:`MultiStreamReceiver.carry`) for observability and
+    tests — to continue a stream across slabs, keep pushing into the
+    SAME receiver (the carry is its live state, not a detached resume
     token)."""
     tail: np.ndarray
     offset: int
     emitted: int
+    watermark: int = 0
+
+
+def _chunk_candidates(seen, off, own, starts, k: int):
+    """The shared dedupe/ownership core of the streaming drains —
+    single-stream and per-fleet-lane alike, so the two receivers can
+    never drift on the trickiest host logic: prune `seen` to the
+    watermark `off` (starts are non-decreasing across chunks, so no
+    future chunk can re-own a start below it — the receiver holds
+    O(K) entries, not one per frame ever emitted), then collect the
+    chunk's owned, unseen (abs_start, lane row) candidates in stream
+    order. Returns (pruned seen, candidates); the caller stores the
+    pruned set and records `off` as the carry's watermark."""
+    seen = {s for s in seen if s >= off}
+    cands = []
+    for j in range(k):
+        if not own[j]:
+            continue
+        abs_start = off + int(starts[j])
+        if abs_start in seen:
+            continue             # safety net; ownership + dead
+        seen.add(abs_start)      # zone already make starts unique
+        cands.append((abs_start, j))
+    cands.sort()
+    return seen, cands
 
 
 class StreamStats(NamedTuple):
@@ -492,6 +520,7 @@ class StreamReceiver:
         self._tail = np.zeros((0, 2), np.float32)
         self._offset = 0
         self._emitted = 0
+        self._watermark = 0
         self._seen = set()
         self._pending = None       # (offset, host chunk, valid, outs)
         self._inflight = 0
@@ -504,7 +533,8 @@ class StreamReceiver:
 
     @property
     def carry(self) -> StreamCarry:
-        return StreamCarry(self._tail, self._offset, self._emitted)
+        return StreamCarry(self._tail, self._offset, self._emitted,
+                           self._watermark)
 
     @property
     def stats(self) -> StreamStats:
@@ -610,20 +640,9 @@ class StreamReceiver:
         if bool(np.asarray(overflow)):
             self._overflow_chunks += 1
 
-        # prune dedupe entries no future chunk can re-own (starts are
-        # non-decreasing across chunks), so a long-running push-driven
-        # receiver holds O(K) entries, not one per frame ever emitted
-        self._seen = {s for s in self._seen if s >= off}
-        cands = []
-        for j in range(self.k):
-            if not own[j]:
-                continue
-            abs_start = off + int(starts[j])
-            if abs_start in self._seen:
-                continue             # safety net; ownership + dead
-            self._seen.add(abs_start)  # zone already make starts unique
-            cands.append((abs_start, j))
-        cands.sort()
+        self._watermark = off
+        self._seen, cands = _chunk_candidates(self._seen, off, own,
+                                              starts, self.k)
 
         if not self.streaming:
             # the per-capture oracle: the SAME detected windows, each
@@ -734,6 +753,442 @@ def receive_stream(samples, chunk_len: int = 1 << 13,
     frames = sr.push(samples)
     frames += sr.flush()
     return frames, sr.stats
+
+
+# ------------------------------------------------- multi-stream receiver
+#
+# `receive_stream` decodes ONE stream per process; "millions of users"
+# is MANY concurrent streams on one device fleet. `receive_streams` +
+# the push-driven `MultiStreamReceiver` stack S independent streams'
+# chunks on a leading STREAM AXIS and run them through the stream-
+# axis-vmapped twins of the two compiled streaming programs
+# (`rx._jit_stream_chunk_multi` / `rx._jit_stream_decode_multi`), so
+# an entire S-stream fleet still runs on TWO compiled programs at
+# <= 2 dispatches per CHUNK-STEP — independent of S. Ragged arrival
+# is handled host-side by a packer: a chunk-step fires only when at
+# least one stream has a full chunk, streams without one ride the
+# step as idle lanes behind a valid-mask (`valid == 0` → the detector
+# caps their positions to nothing), and the all-noise fast path is
+# preserved (a step with zero decodable lanes across the WHOLE fleet
+# skips the decode dispatch entirely). The stream axis shards over
+# the dp mesh (`parallel/batch.frame_mesh` / `lane_sharding`,
+# shard_map via the utils/compat shim — multihost-ready through
+# `parallel/multihost.build_mesh`, dp being the axis with no
+# steady-state collectives). Every emitted frame is bit-identical to
+# S separate single-stream `StreamReceiver`s BY CONSTRUCTION: the
+# per-stream chunk boundaries, ownership windows, and per-lane graphs
+# are exactly the single-stream ones — the vmap only adds the axis.
+
+
+def multi_stream_enabled(multi: Optional[bool] = None) -> bool:
+    """The ONE reading of the --multi-stream / ZIRIA_MULTI_STREAM knob
+    (default ON): whether `receive_streams` runs the stream-axis fleet
+    path or falls back to S independent single-stream
+    `StreamReceiver`s (the bit-identity oracle — >= S x the fleet's
+    dispatch count). The env value is the CLI's declared lane count;
+    only ``"0"`` disables."""
+    import os
+
+    if multi is not None:
+        return multi
+    return os.environ.get("ZIRIA_MULTI_STREAM", "1") != "0"
+
+
+class MultiStreamStats(NamedTuple):
+    streams: int               # S, the fleet width
+    chunk_steps: int           # fleet scan dispatches issued (oracle
+    #                            mode: per-stream chunks, summed)
+    frames: int                # StreamFrames emitted, all streams
+    overflow_chunks: int       # per-stream chunk overflow flags raised
+    max_in_flight: int         # high-water chunk-steps in flight
+    max_active_streams: int    # high-water active lanes in one step
+
+
+class MultiStreamReceiver:
+    """Push-driven S-stream receiver: feed per-stream sample slabs
+    with :meth:`push` (one stream) or :meth:`push_many` (a slab per
+    stream), close with :meth:`flush`; all return the
+    ``(stream, StreamFrame)`` pairs that became decodable.
+
+    Geometry is the single-stream receiver's (`chunk_len` windows
+    overlapping by `frame_len`, up to `max_frames_per_chunk` frames
+    per chunk per stream), applied PER STREAM: each stream steps
+    through exactly the chunk boundaries a lone `StreamReceiver`
+    would, so lane-for-lane bit-identity with S separate receivers
+    holds by construction. One chunk-step = one stacked
+    (S, chunk_len, 2) upload + ONE vmapped scan dispatch (+ ONE
+    flattened decode dispatch when any stream has a decodable frame),
+    double-buffered like the single-stream loop. `mesh` shards the
+    stream axis over dp (`S % mesh.size == 0`); per-stream carries
+    (:class:`StreamCarry`, dedupe watermark included) are visible via
+    :meth:`carry`/:attr:`carries`."""
+
+    def __init__(self, n_streams: int, chunk_len: int = 1 << 13,
+                 frame_len: int = 2048, max_frames_per_chunk: int = 8,
+                 check_fcs: bool = False, threshold: float = 0.75,
+                 min_run: int = 33, dead_zone: int = 320,
+                 viterbi_window: int = None, viterbi_metric: str = None,
+                 viterbi_radix: int = None, mesh=None,
+                 axis: str = "dp"):
+        from ziria_tpu.ops.viterbi import _check_radix
+        from ziria_tpu.phy.wifi import rx as _rx
+
+        if n_streams < 1:
+            raise ValueError(f"n_streams {n_streams} must be >= 1")
+        if frame_len != _rx._stream_bucket(frame_len):
+            raise ValueError(
+                f"frame_len {frame_len} is not a power-of-two >= 512 "
+                f"capture bucket; per-capture receive would pad to "
+                f"{_rx._stream_bucket(frame_len)} and the identity "
+                f"contract needs identical geometry")
+        if chunk_len <= frame_len:
+            raise ValueError(
+                f"chunk_len {chunk_len} must exceed the frame_len "
+                f"{frame_len} overlap (the owned region would be empty)")
+        if mesh is not None and n_streams % mesh.size:
+            raise ValueError(
+                f"n_streams {n_streams} must divide the mesh "
+                f"({mesh.size} devices): the stream axis shards evenly "
+                f"(shard_batch's rule)")
+        self.s = int(n_streams)
+        self.chunk_len = int(chunk_len)
+        self.frame_len = int(frame_len)
+        self.stride = self.chunk_len - self.frame_len
+        self.k = int(max_frames_per_chunk)
+        self.n_sym_bucket = _rx._sym_bucket(
+            max(1, (self.frame_len - _rx.FRAME_DATA_START) // 80))
+        self.check_fcs = check_fcs
+        self.viterbi_window = viterbi_window
+        self.viterbi_metric = viterbi_metric
+        self.viterbi_radix = _check_radix(viterbi_radix)
+        self.mesh = mesh
+        self.axis = axis
+        self._jit1 = _rx._jit_stream_chunk_multi(
+            self.k, self.frame_len, self.n_sym_bucket,
+            float(threshold), int(min_run), int(dead_zone), mesh, axis)
+        self._tails = [np.zeros((0, 2), np.float32)
+                       for _ in range(self.s)]
+        self._offsets = [0] * self.s
+        self._emitted = [0] * self.s
+        self._watermarks = [0] * self.s
+        self._seen = [set() for _ in range(self.s)]
+        self._pending = None   # (offset snapshot, active, outs)
+        self._inflight = 0
+        self._chunk_steps = 0
+        self._overflow_chunks = 0
+        self._max_in_flight = 0
+        self._max_active = 0
+        self._flushed = False
+
+    # -- state ----------------------------------------------------------
+
+    def carry(self, stream: int) -> StreamCarry:
+        """Stream `stream`'s live :class:`StreamCarry` (tail, offset,
+        emitted, dedupe watermark) — read-only observability, exactly
+        like the single-stream receiver's."""
+        return StreamCarry(self._tails[stream], self._offsets[stream],
+                           self._emitted[stream],
+                           self._watermarks[stream])
+
+    @property
+    def carries(self) -> List[StreamCarry]:
+        return [self.carry(i) for i in range(self.s)]
+
+    @property
+    def stats(self) -> MultiStreamStats:
+        return MultiStreamStats(self.s, self._chunk_steps,
+                                sum(self._emitted),
+                                self._overflow_chunks,
+                                self._max_in_flight, self._max_active)
+
+    # -- the push surface -----------------------------------------------
+
+    def push(self, stream: int, samples) -> List:
+        """Append samples ((n, 2) float pairs) to one stream; fire
+        every chunk-step that completes. Returns the emitted
+        ``(stream, StreamFrame)`` pairs (any stream may emit — a
+        completed step drains the previous step's emissions)."""
+        if self._flushed:
+            raise RuntimeError("push after flush")
+        if not 0 <= stream < self.s:
+            raise IndexError(f"stream {stream} not in [0, {self.s})")
+        arr = np.asarray(samples, np.float32)
+        if arr.size:
+            self._tails[stream] = np.concatenate(
+                [self._tails[stream], arr], axis=0)
+        return self._pump()
+
+    def push_many(self, slabs) -> List:
+        """Append one slab per stream (empty slabs fine), THEN pump:
+        streams that filled a chunk together ride the same chunk-step
+        — the packer's lockstep fast path for synchronized feeds."""
+        if self._flushed:
+            raise RuntimeError("push after flush")
+        if len(slabs) != self.s:
+            raise ValueError(f"{self.s} streams need {self.s} slabs, "
+                             f"got {len(slabs)}")
+        for i, s in enumerate(slabs):
+            arr = np.asarray(s, np.float32)
+            if arr.size:
+                self._tails[i] = np.concatenate(
+                    [self._tails[i], arr], axis=0)
+        return self._pump()
+
+    def flush(self) -> List:
+        """Close every stream: scan the carried tails (zero-padded to
+        the chunk geometry, each stream owning every remaining start)
+        as one final chunk-step, then drain the in-flight step.
+        Idempotent."""
+        if self._flushed:
+            return []
+        out = self._pump()
+        self._flushed = True
+        active = [i for i in range(self.s)
+                  if self._tails[i].shape[0]]
+        if active:
+            out += self._step(active, flushing=True)
+        if self._pending is not None:
+            pend, self._pending = self._pending, None
+            out += self._drain(pend)
+        return out
+
+    # -- chunk-step lifecycle -------------------------------------------
+
+    def _pump(self) -> List:
+        out: List = []
+        while True:
+            active = [i for i in range(self.s)
+                      if self._tails[i].shape[0] >= self.chunk_len]
+            if not active:
+                return out
+            out += self._step(active, flushing=False)
+
+    def _step(self, active, flushing: bool) -> List:
+        """Build one stacked chunk-step over the `active` streams
+        (idle lanes ride zeros behind `valid == 0`), launch it, and
+        advance the active streams' host carries."""
+        from ziria_tpu.utils import dispatch
+
+        arrs = np.zeros((self.s, self.chunk_len, 2), np.float32)
+        valid = np.zeros(self.s, np.int32)
+        own_lo = np.zeros(self.s, np.int32)
+        own_hi = np.zeros(self.s, np.int32)
+        adv = {}
+        for i in active:
+            t = self._tails[i]
+            if flushing:
+                v = t.shape[0]
+                arrs[i, :v] = t
+                valid[i] = own_hi[i] = v
+                adv[i] = v
+            else:
+                arrs[i] = t[:self.chunk_len]
+                valid[i] = self.chunk_len
+                own_hi[i] = self.stride
+                adv[i] = self.stride
+            # the stream's FIRST chunk owns head-truncated preambles
+            # (start clamps to 0), exactly the single-stream rule
+            own_lo[i] = -192 if self._offsets[i] == 0 else 0
+        offs = list(self._offsets)          # snapshot BEFORE advancing
+        res = self._launch(arrs, valid, own_lo, own_hi, active, offs)
+        for i in active:
+            self._tails[i] = self._tails[i][adv[i]:]
+            self._offsets[i] += adv[i]
+            # per-stream carry depth: with telemetry active these are
+            # the per-stream counter-track rows next to the aggregate
+            dispatch.record_gauge(f"rx.stream_carry_depth[s{i}]",
+                                  self._tails[i].shape[0])
+        dispatch.record_gauge("rx.stream_carry_depth",
+                              sum(t.shape[0] for t in self._tails))
+        return res
+
+    def _put(self, x):
+        """Host array -> device, stream axis sharded when a mesh is
+        set (the `sweep_ber_sharded` placement rule)."""
+        import jax
+
+        if self.mesh is None:
+            return jax.device_put(x)
+        from ziria_tpu.parallel import batch as pbatch
+        return pbatch.shard_batch(self.mesh, x, self.axis)
+
+    def _launch(self, arrs, valid, own_lo, own_hi, active, offs) -> List:
+        """Issue the stacked upload + scan dispatch, THEN drain the
+        previous chunk-step — the single-stream double buffer, per
+        fleet step: step t's transfer and compute are in flight while
+        the host blocks on step t-1's scalars."""
+        from ziria_tpu.utils import dispatch, programs
+
+        chunk_args = (self._put(arrs), self._put(valid),
+                      self._put(own_lo), self._put(own_hi))
+        programs.note_site("rx.stream_chunk_multi", self._jit1,
+                           *chunk_args)
+        with dispatch.timed("rx.stream_chunk_multi"):
+            outs = self._jit1(*chunk_args)
+        self._chunk_steps += 1
+        self._inflight += 1
+        self._max_in_flight = max(self._max_in_flight, self._inflight)
+        self._max_active = max(self._max_active, len(active))
+        dispatch.record_gauge("rx.stream_inflight", self._inflight)
+        # the fleet-level time series: how many lanes carried real
+        # samples this step (idle lanes are the valid-mask riders)
+        dispatch.record_gauge("rx.active_streams", len(active))
+        pend, self._pending = self._pending, (offs, list(active), outs)
+        return self._drain(pend) if pend is not None else []
+
+    def _drain(self, pend) -> List:
+        """Block on a launched chunk-step's per-lane scalars, run the
+        host integer decision tree per active stream, and emit —
+        dispatching the step's ONE flattened fleet decode when ANY
+        stream has a decodable lane (the all-noise fast path skips it
+        for the whole fleet)."""
+        from ziria_tpu.phy.wifi import rx as _rx
+        from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
+        from ziria_tpu.utils import dispatch, programs
+
+        offs, active, outs = pend
+        (own, starts, overflow, found, fstart, eps, rb, ln, pk, nv,
+         segs) = outs
+        own = np.asarray(own)
+        starts = np.asarray(starts)
+        overflow = np.asarray(overflow)
+        found = np.asarray(found)
+        fstart = np.asarray(fstart)
+        rb = np.asarray(rb)
+        ln = np.asarray(ln)
+        pk = np.asarray(pk)
+        nv = np.asarray(nv)
+        self._inflight -= 1
+        self._overflow_chunks += int(overflow[active].sum())
+
+        emit = {}            # (stream, abs_start) -> RxResult
+        lanes = []           # (stream, abs_start, row j, rate, n_sym, lb)
+        for i in active:
+            off = offs[i]
+            self._watermarks[i] = off
+            self._seen[i], cands = _chunk_candidates(
+                self._seen[i], off, own[i], starts[i], self.k)
+            for abs_start, j in cands:
+                avail = int(nv[i, j]) - int(fstart[i, j])
+                res, ok = _rx._classify_acquire(
+                    bool(found[i, j]), avail, int(rb[i, j]),
+                    int(ln[i, j]), bool(pk[i, j]))
+                if ok is None:
+                    emit[(i, abs_start)] = res
+                else:
+                    lanes.append((i, abs_start, j, ok[0], ok[1],
+                                  int(ln[i, j])))
+        if lanes:
+            # (S, K) row tables, zero-filled past each stream's real
+            # lanes (ridx 0 / nbits 0 = a full-erasure pad decode —
+            # discarded, like every pad lane here); row 0 is safe for
+            # idle streams because segs always holds K rows per stream
+            rows = np.zeros((self.s, self.k), np.int32)
+            ridx = np.zeros((self.s, self.k), np.int32)
+            nbits = np.zeros((self.s, self.k), np.int32)
+            npsdu = np.zeros((self.s, self.k), np.int32)
+            slots = {}
+            for i, abs_start, j, m, n_sym, lb in lanes:
+                sl = slots.setdefault(i, [])
+                pos = len(sl)
+                sl.append((abs_start, m, lb))
+                rows[i, pos] = j
+                ridx[i, pos] = _rx.RATE_INDEX[m]
+                nbits[i, pos] = n_sym * RATES[m].n_dbps
+                npsdu[i, pos] = 8 * lb
+            dec = _rx._jit_stream_decode_multi(
+                self.n_sym_bucket, self.viterbi_window,
+                self.viterbi_metric, self.viterbi_radix,
+                self.mesh, self.axis)
+            dec_args = (segs, self._put(rows), self._put(ridx),
+                        self._put(nbits), self._put(npsdu))
+            programs.note_site("rx.stream_decode_multi", dec, *dec_args)
+            with dispatch.timed("rx.stream_decode_multi"):
+                clear_d, crc_d = dec(*dec_args)
+            clear = np.asarray(clear_d, np.uint8)
+            crc = np.asarray(crc_d)
+            for i, sl in slots.items():
+                for pos, (abs_start, m, lb) in enumerate(sl):
+                    psdu = clear[i, pos][
+                        N_SERVICE_BITS: N_SERVICE_BITS + 8 * lb]
+                    emit[(i, abs_start)] = _rx.RxResult(
+                        True, m, lb, psdu,
+                        bool(crc[i, pos]) if self.check_fcs else None)
+        out = []
+        for key in sorted(emit):
+            i, abs_start = key
+            out.append((i, StreamFrame(abs_start, emit[key])))
+            self._emitted[i] += 1
+        if out:
+            from ziria_tpu.utils import telemetry
+            telemetry.count("rx.stream_frames", len(out),
+                            total=sum(self._emitted))
+        return out
+
+
+def receive_streams(streams, chunk_len: int = 1 << 13,
+                    frame_len: int = 2048,
+                    max_frames_per_chunk: int = 8,
+                    check_fcs: bool = False,
+                    threshold: float = 0.75, min_run: int = 33,
+                    dead_zone: int = 320, viterbi_window: int = None,
+                    viterbi_metric: str = None,
+                    viterbi_radix: int = None,
+                    multi: Optional[bool] = None, mesh=None,
+                    axis: str = "dp"):
+    """Decode S concurrent multi-frame I/Q streams in O(chunk-steps)
+    device dispatches — <= 2 per chunk-step *independent of S*.
+    Returns ``(per_stream_frames, stats)``: a per-stream position-
+    ordered list of :class:`StreamFrame` (each bit-identical, RxResult
+    field for field, to what a lone single-stream receiver — and hence
+    per-capture ``rx.receive`` over the slice — emits for that
+    stream) and the :class:`MultiStreamStats`.
+
+    ``multi=False`` (or ``--no-multi-stream`` / ``ZIRIA_MULTI_STREAM=0``)
+    runs S independent single-stream :class:`StreamReceiver`\\ s — the
+    bit-identity oracle, >= S x the dispatch count. ``mesh`` shards
+    the stream axis over the dp device mesh
+    (`parallel/batch.frame_mesh`; S must divide it). Push-driven
+    callers (live feeds with ragged arrival) use
+    :class:`MultiStreamReceiver` directly."""
+    s = len(streams)
+    if s == 0:
+        return [], MultiStreamStats(0, 0, 0, 0, 0, 0)
+    kw = dict(chunk_len=chunk_len, frame_len=frame_len,
+              max_frames_per_chunk=max_frames_per_chunk,
+              check_fcs=check_fcs, threshold=threshold,
+              min_run=min_run, dead_zone=dead_zone,
+              viterbi_window=viterbi_window,
+              viterbi_metric=viterbi_metric,
+              viterbi_radix=viterbi_radix)
+    if not multi_stream_enabled(multi):
+        if mesh is not None:
+            # a sharded-vs-oracle comparison must never silently
+            # measure the wrong configuration: the oracle is S
+            # unsharded single-stream receivers by definition
+            raise ValueError(
+                "mesh sharding needs the fleet path: multi=False / "
+                "ZIRIA_MULTI_STREAM=0 runs S independent single-"
+                "stream receivers, which cannot honor a stream-axis "
+                "mesh")
+        per, chunks, frames, ovf, infl = [], 0, 0, 0, 0
+        for st in streams:
+            got, stats = receive_stream(np.asarray(st, np.float32),
+                                        **kw)
+            per.append(got)
+            chunks += stats.chunks
+            frames += stats.frames
+            ovf += stats.overflow_chunks
+            infl = max(infl, stats.max_in_flight)
+        return per, MultiStreamStats(s, chunks, frames, ovf, infl,
+                                     1 if chunks else 0)
+    msr = MultiStreamReceiver(s, mesh=mesh, axis=axis, **kw)
+    got = msr.push_many([np.asarray(st, np.float32) for st in streams])
+    got += msr.flush()
+    per = [[] for _ in range(s)]
+    for i, fr in got:
+        per[i].append(fr)
+    return per, msr.stats
 
 
 def transmit_many(psdus, rates_mbps, add_fcs: bool = False,

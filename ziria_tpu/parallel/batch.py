@@ -41,6 +41,17 @@ def shard_batch(mesh: Mesh, x, axis: str = "dp"):
     return jax.device_put(x, lane_sharding(mesh, np.ndim(x), axis))
 
 
+def stream_specs(ndims, axis: str = "dp"):
+    """`shard_map` PartitionSpecs for leading-axis sharding: one spec
+    per rank in `ndims`, each sharding axis 0 over `axis` and
+    replicating the rest — the shard_map twin of :func:`lane_sharding`
+    (the multi-stream receiver's chunk and decode programs pass their
+    argument/result ranks through this so the stream axis always
+    lands on dp, never hand-written per program)."""
+    from jax.sharding import PartitionSpec as P
+    return tuple(P(axis, *([None] * (int(n) - 1))) for n in ndims)
+
+
 def data_parallel(fn: Callable, mesh: Mesh, axis: str = "dp") -> Callable:
     """jit `fn` (batched: leading axis = frames) with the frame axis
     sharded over `axis` on `mesh` for both inputs and outputs.

@@ -421,6 +421,60 @@ def stream_many(psdus, rates_mbps: Sequence[int], gaps=None,
             starts)
 
 
+def _stream_seed(seed: int, i: int) -> int:
+    """Per-stream seed fold-in for `stream_many_multi`: deterministic
+    and collision-free across the fleet for any base seed (the affine
+    map is injective mod the prime, so stream i's gap and noise draws
+    never depend on which other streams ride the load). The offset
+    also keeps lanes off the bare base seed for ordinary seeds — not
+    a universal guarantee (every lane's affine map has one fixed
+    point mod 2^31-1); callers needing a lane provably disjoint from
+    a `stream_many(seed=seed)` stimulus should pick a different base
+    seed."""
+    return (int(seed) * 1000003 + 7919 * (int(i) + 1)) % (2 ** 31 - 1)
+
+
+def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
+                      cfo=0.0, delay=0, seed: int = 0,
+                      add_fcs: bool = False, tail: int = 2048,
+                      gaps=None, batched_tx: Optional[bool] = None):
+    """The S-stream load synthesizer — the stimulus of the multi-
+    stream receiver (`framebatch.receive_streams`) and its bench:
+    stream i is exactly ``stream_many(psdus_per_stream[i],
+    rates_per_stream[i], ...)`` at the per-stream folded seed
+    (`_stream_seed`), so every stream carries its own frames, gaps,
+    CFO rotation, and noise draws, mutually independent and
+    reproducible per lane. ``snr_db``/``cfo``/``delay`` broadcast
+    scalar-or-per-stream (the `loopback_many` rule); ``gaps`` is
+    None or a length-S sequence of per-stream gap sequences.
+
+    Returns ``(streams, starts_per_stream)``: S (n_i, 2) f32 streams
+    (lengths ragged — the receiver's packer handles that) and each
+    stream's TRUE frame-start indices, the ground truth the fleet
+    identity contract slices at."""
+    s = len(psdus_per_stream)
+    if len(rates_per_stream) != s:
+        raise ValueError(f"{s} streams of PSDUs but "
+                         f"{len(rates_per_stream)} of rates")
+    if gaps is not None and len(gaps) != s:
+        raise ValueError(f"{s} streams need {s} gap sequences, "
+                         f"got {len(gaps)}")
+    snr = _lane_param(snr_db, s, np.float64)
+    eps = _lane_param(cfo, s, np.float64)
+    dly = _lane_param(delay, s, np.int64)
+    streams, starts = [], []
+    for i in range(s):
+        st, sts = stream_many(
+            psdus_per_stream[i], rates_per_stream[i],
+            gaps=None if gaps is None else gaps[i],
+            snr_db=float(snr[i]), cfo=float(eps[i]),
+            delay=int(dly[i]), seed=_stream_seed(seed, i),
+            add_fcs=add_fcs, tail=tail, batched_tx=batched_tx)
+        streams.append(st)
+        starts.append(sts)
+    return streams, starts
+
+
 def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
                       batched_tx: Optional[bool] = None) -> np.ndarray:
     """Perfect-sync single-rate BER loopback — the statistical lane of

@@ -952,6 +952,105 @@ def _jit_stream_decode(n_sym_bucket: int, viterbi_window: int = None,
     return jax.jit(f)
 
 
+# --------------------------------------------------- multi-stream fleet
+#
+# The S-stream twins of the two streaming programs: S independent I/Q
+# streams' chunks ride a LEADING STREAM AXIS through the same per-lane
+# graphs (`stream_chunk_graph` under one more vmap; the mixed decode
+# over the flattened (S*K) lane axis), so an entire fleet of streams
+# still runs on TWO compiled programs and <= 2 dispatches per
+# chunk-step — Ziria's `|>>>|` stage placement re-expressed as a mesh
+# axis. With a `mesh`, both programs wrap in `shard_map` (via the
+# utils/compat shim) over the dp stream axis: an identical per-device
+# program per shard of streams, no collectives (streams are
+# independent), multihost-ready through parallel/multihost.build_mesh.
+
+
+def multi_stream_chunk_graph(chunks, valid, own_lo, own_hi, k: int,
+                             win_len: int, n_sym_bucket: int,
+                             threshold: float = 0.75, min_run: int = 33,
+                             dead_zone: int = 320):
+    """The stream-axis twin of `stream_chunk_graph`: `chunks`
+    (S, chunk_len, 2) stacked per-stream windows, `valid`/`own_lo`/
+    `own_hi` (S,) per-stream scalars (an idle lane rides `valid == 0`
+    — the detector's position cap masks it to zero candidates, the
+    valid-mask of the host packer). Per lane, values are the SINGLE-
+    stream graph's values by construction — the vmap adds the stream
+    axis, nothing else — which is what makes the fleet bit-identical
+    to S separate receivers."""
+    return jax.vmap(
+        lambda c, v, lo, hi: stream_chunk_graph(
+            c, v, lo, hi, k, win_len, n_sym_bucket, threshold,
+            min_run, dead_zone))(chunks, valid, own_lo, own_hi)
+
+
+@lru_cache(maxsize=None)
+def _jit_stream_chunk_multi(k: int, win_len: int, n_sym_bucket: int,
+                            threshold: float = 0.75, min_run: int = 33,
+                            dead_zone: int = 320, mesh=None,
+                            axis: str = "dp"):
+    """ONE compiled S-stream chunk scan per (K, window, symbol bucket,
+    detector params, mesh) — stream count and chunk length retrace per
+    shape, so a fleet of uniform chunk-steps compiles ONCE. With a
+    `mesh`, the graph wraps in shard_map over the leading stream axis
+    (`parallel/batch.stream_specs` placement, compat shim): each
+    device runs the identical per-shard program over its S/n streams.
+    `mesh` is part of the lru key (a Mesh hashes by device layout), so
+    sharded and unsharded fleets never share a trace."""
+    def f(chunks, valid, own_lo, own_hi):
+        return multi_stream_chunk_graph(chunks, valid, own_lo, own_hi,
+                                        k, win_len, n_sym_bucket,
+                                        threshold, min_run, dead_zone)
+
+    if mesh is None:
+        return jax.jit(f)
+    from ziria_tpu.parallel.batch import stream_specs
+    from ziria_tpu.utils.compat import shard_map
+    # outputs: own/starts (S,K), overflow (S,), 7x per-lane (S,K)
+    # scalars, segs (S,K,need_b,2) — every one leads with the stream
+    # axis, so the specs are rank-driven
+    return jax.jit(shard_map(
+        f, mesh=mesh, in_specs=stream_specs((3, 1, 1, 1), axis),
+        out_specs=stream_specs((2, 2, 1) + (2,) * 7 + (4,), axis)))
+
+
+@lru_cache(maxsize=None)
+def _jit_stream_decode_multi(n_sym_bucket: int, viterbi_window: int = None,
+                             viterbi_metric: str = None,
+                             viterbi_radix: int = None, mesh=None,
+                             axis: str = "dp"):
+    """Dispatch 2 of the multi-stream chunk-step: per-stream row-
+    select of the decodable lanes (all inside the jit, over the still
+    device-resident (S, K, ...) segment batch), then the (S*K)-lane
+    FLATTENED mixed-rate decode + masked CRC — one rate-agnostic
+    Pallas Viterbi batch for the whole fleet, every lane riding the
+    same 128-lane tiles (lane values are batch-independent, the
+    pinned receive_many contract, so each lane is bit-identical to
+    its single-stream K-lane decode). Decode-mode knobs and the mesh
+    are cache keys, as in every jit factory here."""
+    def f(segs, rows, ridx, nbits, npsdu):
+        sel = jax.vmap(lambda sg, r: sg[r])(segs, rows)
+        s, kk = rows.shape
+        clear = decode_data_mixed(
+            sel.reshape((s * kk,) + sel.shape[2:]), ridx.reshape(-1),
+            nbits.reshape(-1), n_sym_bucket, viterbi_window,
+            viterbi_metric, viterbi_radix)
+        crc = crc_psdu_many_graph(clear, npsdu.reshape(-1))
+        return (clear.reshape(s, kk, -1), crc.reshape(s, kk))
+
+    if mesh is None:
+        return jax.jit(f)
+    from ziria_tpu.parallel.batch import stream_specs
+    from ziria_tpu.utils.compat import shard_map
+    # check_vma=False (compat: check_rep on this image's jax): the
+    # Pallas ACS inside the decode has no replication rule; nothing
+    # here is replicated anyway — every operand leads with the
+    # sharded stream axis
+    return jax.jit(shard_map(
+        f, mesh=mesh, in_specs=stream_specs((4, 2, 2, 2, 2), axis),
+        out_specs=stream_specs((3, 2), axis), check_vma=False))
+
+
 def receive(samples, check_fcs: bool = False,
             max_samples: int = 1 << 16, fxp: bool = False,
             viterbi_window: int = None,

@@ -470,6 +470,16 @@ def run_driver() -> None:
                               max_frames_per_chunk=8, check_fcs=True,
                               streaming=True)
 
+    # multi-stream fleet: the stream-axis twins (stream_chunk_multi +
+    # stream_decode_multi) over a 2-stream load at the same geometry
+    streams, _st = link.stream_many_multi(
+        [psdus[:1], psdus[1:]], [rates[:1], rates[1:]],
+        snr_db=30.0, cfo=1e-4, delay=60, seed=9, add_fcs=True,
+        tail=1024)
+    framebatch.receive_streams(streams, chunk_len=4096, frame_len=1024,
+                               max_frames_per_chunk=8, check_fcs=True,
+                               multi=True)
+
 
 def collect_programs(hlo_dump: Optional[str] = None,
                      driver=run_driver) -> Dict[str, Any]:
