@@ -98,6 +98,7 @@ STAGE_METRICS = {
     "ber_sweep": ("points_per_s_sweep", "higher"),
     "streaming_rx": ("sps_streaming", "higher"),
     "multi_stream": ("sps_multi", "higher"),
+    "resilience": ("faults_recovered", "higher"),
     "lint": ("findings_total", "lower"),
     "programs": ("programs_analyzed", "higher"),
     "numpy_baseline": ("sps", "higher"),
@@ -1528,6 +1529,41 @@ def _child_main(run_id):
             note(f"multi stream stage failed: {e!r}")
             multi_ev = {"error": repr(e)}
 
+    # ISSUE 12 tentpole evidence: the chaos run of the multi-stream
+    # fleet (tools/rx_dispatch_bench.resilience_stats) — injected
+    # transient/fatal/latency/NaN-slab faults over the chunk-steps,
+    # asserting ZERO crashes, healthy-lane bit-identity, quarantine
+    # rejoin, and checkpoint/restore resumption; retries/fallbacks/
+    # quarantines recorded. Same resumable never-fatal discipline.
+    def _resilience_stage():
+        if time.time() - t0 > 0.95 * budget:
+            raise TimeoutError("skipped: child time budget")
+        cpu = os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+        ev = _load_rx_dispatch_bench().resilience_stats(
+            n_streams=4 if cpu else 8,
+            frames_per_stream=2 if cpu else 3)
+        note(f"resilience: {ev['faults_injected']} fault(s) injected "
+             f"over {ev['chunk_steps']} chunk-steps "
+             f"({ev['faults_per_100_steps']}/100 steps, by kind "
+             f"{ev['faults_by_kind']}): {ev['retries']} retried, "
+             f"degraded={ev['degraded']}, "
+             f"{ev['quarantines']} quarantine(s) "
+             f"({ev['frames_dropped_quarantined']} frame(s) dropped, "
+             f"rejoined), healthy lanes bit-identical, "
+             f"checkpoint roundtrip bit-identical, zero crashes")
+        part("resilience", **ev)
+        return ev
+
+    if "resilience" in resume:
+        res_ev = reuse(resume["resilience"])
+        note("resilience resumed from prior window")
+    else:
+        try:
+            res_ev = _resilience_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"resilience stage failed: {e!r}")
+            res_ev = {"error": repr(e)}
+
     # ISSUE 8 tentpole evidence: the jaxlint static-analysis sweep —
     # per-rule finding counts (and the suppression count) over
     # ziria_tpu/, recorded in the artifact so the trend — and any
@@ -1672,6 +1708,7 @@ def _child_main(run_id):
         "ber_sweep": sweep_ev,
         "streaming_rx": stream_ev,
         "multi_stream": multi_ev,
+        "resilience": res_ev,
         "lint": lint_ev,
         "programs": prog_ev,
         "roofline": _roofline(

@@ -16,6 +16,17 @@ if ! python -m ziria_tpu lint ziria_tpu/; then
   exit 1
 fi
 
+# chaos smoke (ISSUE 12): the fault-injection + guarded-dispatch
+# machinery exercised against stub dispatches — sub-10s, CPU-only,
+# never imports jax (works through TPU probe hangs, like the lint
+# gate). A broken resilience layer must not reach a commit.
+if ! timeout 30 python tools/chaos_smoke.py; then
+  echo "[precommit] chaos smoke FAILED (tools/chaos_smoke.py) —" \
+       "commit refused" >&2
+  echo "[precommit] (ZIRIA_SKIP_TESTGATE=1 to override for WIP)" >&2
+  exit 1
+fi
+
 # perf-ledger regression gate (ISSUE 9): latest vs previous
 # same-platform run in BENCH_TRAJECTORY.jsonl. Lenient tolerance —
 # bench numbers on a shared box are noisy; the gate exists to catch

@@ -808,6 +808,167 @@ def multi_stream_stats(n_streams=8, frames_per_stream=4, n_bytes=12,
     return out
 
 
+def resilience_stats(n_streams=4, frames_per_stream=3, n_bytes=12,
+                     snr_db=30.0, chunk_len=4096, frame_len=1024,
+                     k=8, seed=12):
+    """Chaos run of the multi-stream fleet (ISSUE 12): the fleet is
+    fed push-driven under an injected fault plan — transient scan and
+    decode faults (retried), a dispatch-latency fault, a NaN slab into
+    stream 0 (sanitize=True zero-and-quarantine, rejoin after 2 clean
+    chunks), and a one-shot FATAL decode fault (degrade to the
+    per-capture oracle) — asserting ZERO crashes, healthy-lane
+    lane-for-lane bit-identity vs a fault-free run, no garbage
+    emissions from the poisoned lane, full quarantine recovery
+    (rejoined by stream end), and a checkpoint/restore roundtrip
+    bit-identical to an uninterrupted receiver. Records
+    retries/fallbacks/quarantines/sanitized counts and the fault rate
+    per 100 chunk-steps. Returns a flat dict (metric:
+    ``faults_recovered``)."""
+    from ziria_tpu.backend import framebatch
+    from ziria_tpu.phy import link
+    from ziria_tpu.phy.wifi.params import RATES
+    from ziria_tpu.utils import faults, telemetry
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    rng = np.random.default_rng(29)
+    rates_all = sorted(RATES)
+    psdus_per, rates_per = [], []
+    for i in range(n_streams):
+        rates = [rates_all[(i + j) % len(rates_all)]
+                 for j in range(frames_per_stream)]
+        rates_per.append(rates)
+        psdus_per.append([rng.integers(0, 256, n_bytes)
+                          .astype(np.uint8) for _ in rates])
+    # every stream spreads its frames ~3 chunks apart so the workload
+    # spans several chunk-steps AND several decode dispatches: the
+    # quarantine (on stream 0) gets clean chunks to rejoin across,
+    # and the one-shot fatal decode fault has a later decode to hit
+    streams, starts = link.stream_many_multi(
+        psdus_per, rates_per, snr_db=snr_db, cfo=1e-4, delay=60,
+        seed=11, add_fcs=True, tail=frame_len,
+        gaps=[[9000] * (frames_per_stream - 1)] * n_streams)
+    kw = dict(chunk_len=chunk_len, frame_len=frame_len,
+              max_frames_per_chunk=k, check_fcs=True)
+
+    # fault-free reference (also pre-compiles both fleet programs so
+    # the chaos pass times recovery, not first-contact compiles)
+    res_c, st_c = framebatch.receive_streams(streams, multi=True,
+                                             **kw)
+    per_c = res_c
+
+    specs = (
+        faults.FaultSpec("rx.stream_chunk_multi", "transient",
+                         every=3),
+        faults.FaultSpec("rx.stream_decode_multi", "transient",
+                         every=4),
+        faults.FaultSpec("rx.stream_chunk_multi", "delay",
+                         calls=(4,), delay_s=0.02),
+        faults.FaultSpec("rx.push.s0", "nan_slab", calls=(1,),
+                         fraction=0.2),
+        faults.FaultSpec("rx.stream_decode_multi", "fatal",
+                         calls=(1,), count=1),
+    )
+    t0 = time.perf_counter()
+    with telemetry.collect() as reg:
+        with count_dispatches() as d:
+            with faults.inject(*specs, seed=seed) as plan:
+                msr = framebatch.MultiStreamReceiver(
+                    n_streams, sanitize=True, rejoin_after=2, **kw)
+                got = []
+                step = chunk_len // 2
+                hi = max(int(s.shape[0]) for s in streams)
+                for a in range(0, hi, step):
+                    got += msr.push_many(
+                        [s[a: a + step] for s in streams])
+                got += msr.flush()
+    t_chaos = time.perf_counter() - t0
+    # reaching here IS the first gate: zero process crashes
+    per = [[] for _ in range(n_streams)]
+    for i, fr in got:
+        per[i].append(fr)
+
+    # attribution: streams whose push seam a data fault actually hit
+    corrupted = set()
+    for site, kind, _idx in plan.fired:
+        if site.startswith("rx.push.s"):
+            corrupted.add(int(site[len("rx.push.s"):]))
+    same = (lambda a, b: a.ok == b.ok and a.rate_mbps == b.rate_mbps
+            and a.length_bytes == b.length_bytes
+            and np.array_equal(a.psdu_bits, b.psdu_bits)
+            and a.crc_ok == b.crc_ok)
+    for i in range(n_streams):
+        if i in corrupted:
+            # poisoned lane: every surviving frame must match the
+            # clean run (dropped-while-quarantined, never garbage)
+            clean_by_start = {f.start: f for f in per_c[i]}
+            for f in per[i]:
+                assert f.start in clean_by_start and same(
+                    f.result, clean_by_start[f.start].result), \
+                    f"stream {i} emitted garbage under chaos"
+        else:
+            # healthy lanes: lane-for-lane bit-identical
+            assert [f.start for f in per[i]] == \
+                [f.start for f in per_c[i]], \
+                f"healthy stream {i} diverged under chaos"
+            for a, b in zip(per[i], per_c[i]):
+                assert same(a.result, b.result), \
+                    f"healthy stream {i} diverged under chaos"
+    stats = msr.stats
+    assert stats.quarantined_streams == 0, \
+        "a quarantined stream failed to rejoin"
+    dropped = sum(len(per_c[i]) - len(per[i]) for i in corrupted)
+
+    # checkpoint/restore roundtrip: bit-identical resumption
+    sr1 = framebatch.StreamReceiver(**kw)
+    cut = int(streams[1].shape[0]) // 2
+    first = sr1.push(streams[1][:cut])
+    state, drained = sr1.checkpoint()
+    first += drained
+    sr2 = framebatch.StreamReceiver(checkpoint=state, **kw)
+    rest = sr2.push(streams[1][cut:])
+    rest += sr2.flush()
+    resumed = first + rest
+    assert [f.start for f in resumed] == \
+        [f.start for f in per_c[1]] and all(
+            same(a.result, b.result)
+            for a, b in zip(resumed, per_c[1])), \
+        "checkpoint/restore resumption diverged"
+
+    snap = reg.snapshot()
+    fired_by_kind = {}
+    for _s, kind, _i in plan.fired:
+        fired_by_kind[kind] = fired_by_kind.get(kind, 0) + 1
+    return {
+        "streams": n_streams, "frames_per_stream": frames_per_stream,
+        "frame_bytes": n_bytes,
+        "chunk_steps": stats.chunk_steps,
+        "faults_injected": plan.total_fired,
+        "faults_recovered": plan.total_fired,   # zero crashes gated
+        "faults_by_kind": fired_by_kind,
+        "faults_per_100_steps": round(
+            100.0 * plan.total_fired / max(stats.chunk_steps, 1), 1),
+        "retries": snap.get("resilience.retries", 0),
+        "recovered": snap.get("resilience.recovered", 0),
+        "fallbacks": snap.get("resilience.fallbacks", 0),
+        "sanitized": stats.sanitized,
+        "quarantines": stats.quarantines,
+        "quarantined_at_end": stats.quarantined_streams,
+        "lane_blowups": stats.lane_blowups,
+        "degraded": bool(stats.degraded),
+        "frames_clean": sum(len(r) for r in per_c),
+        "frames_chaos": sum(len(r) for r in per),
+        "frames_dropped_quarantined": dropped,
+        "corrupted_streams": sorted(corrupted),
+        "dispatch_breakdown_chaos": dict(d.counts),
+        "backoff_s": snap.get("resilience.backoff_seconds",
+                              {"count": 0}),
+        "t_chaos_s": round(t_chaos, 4),
+        "healthy_bit_identical": True,
+        "checkpoint_bit_identical": True,
+        "zero_crashes": True,
+    }
+
+
 def viterbi_breakdown(B=128, n_bytes=1000, rate_mbps=54, k1=4, k2=12):
     """ACS-only vs traceback-only vs front-end-only vs full decode at
     the bench shape — the answer to bench.py's open question ("the
@@ -1122,6 +1283,8 @@ def main():
         out["streaming_rx"] = streaming_stats(n_frames=8)
         out["multi_stream"] = multi_stream_stats(
             n_streams=4, frames_per_stream=2)
+        out["resilience"] = resilience_stats(
+            n_streams=4, frames_per_stream=2)
     else:
         out["quantized"] = quantized_sweep()
         out["viterbi_breakdown"] = viterbi_breakdown()
@@ -1135,6 +1298,7 @@ def main():
         out["ber_sweep"] = ber_sweep_stats()
         out["streaming_rx"] = streaming_stats()
         out["multi_stream"] = multi_stream_stats()
+        out["resilience"] = resilience_stats()
     print(json.dumps(out))
     return 0
 

@@ -453,11 +453,183 @@ def _chunk_candidates(seen, off, own, starts, k: int):
     return seen, cands
 
 
+def _slab_array(samples, name: str) -> np.ndarray:
+    """The push-seam shape/dtype gate (docs/robustness.md): coerce a
+    pushed slab to (n, 2) float32 I/Q pairs or raise a ValueError
+    NAMING the stream — malformed input fails at the seam, never as
+    garbage inside the detector."""
+    try:
+        arr = np.asarray(samples, np.float32)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"{name}: pushed slab is not float-convertible "
+            f"((n, 2) I/Q sample pairs expected): {e}") from None
+    if arr.size == 0:
+        return arr.reshape(0, 2)
+    if arr.ndim != 2 or arr.shape[1] != 2:
+        raise ValueError(
+            f"{name}: pushed slab has shape {arr.shape}, want (n, 2) "
+            f"I/Q sample pairs")
+    return arr
+
+
+class _LaneHealth:
+    """Per-stream quarantine state (shared by the single-stream and
+    fleet receivers so the two can never drift): non-finite input
+    poisons the lane immediately; ``blowup_limit`` repeated per-lane
+    decode blowups poison it too; a poisoned lane rides behind the
+    valid-mask (``valid == 0`` — its chunks scan to nothing, healthy
+    lanes untouched by construction) and rejoins after
+    ``rejoin_after`` consecutive clean chunks."""
+
+    __slots__ = ("blowup_limit", "rejoin_after", "quarantined",
+                 "clean", "blowups", "quarantines")
+
+    def __init__(self, blowup_limit: int = 2, rejoin_after: int = 3):
+        self.blowup_limit = max(1, int(blowup_limit))
+        self.rejoin_after = max(1, int(rejoin_after))
+        self.quarantined = False
+        self.clean = 0          # consecutive clean chunks in quarantine
+        self.blowups = 0        # consecutive per-lane decode blowups
+        self.quarantines = 0    # times this lane entered quarantine
+
+    def poison(self) -> None:
+        if not self.quarantined:
+            self.quarantines += 1
+            from ziria_tpu.utils import telemetry
+            telemetry.count("resilience.quarantines")
+        self.quarantined = True
+        self.clean = 0
+
+    def blowup(self) -> None:
+        self.blowups += 1
+        if self.blowups >= self.blowup_limit:
+            self.poison()
+            self.blowups = 0
+
+    def step(self, dirty: bool) -> bool:
+        """Advance one consumed chunk; True = this chunk rides
+        quarantined (valid 0). A dirty chunk resets the clean streak;
+        rejoin takes effect from the chunk AFTER the streak fills.
+        Blowups are NOT reset here: a chunk's blowups are delivered
+        one drain later than its step (the double buffer), so a
+        per-step reset could never see two in a row — the count
+        accumulates until the lane is poisoned or rejoins."""
+        if dirty:
+            self.clean = 0
+            return self.quarantined
+        if self.quarantined:
+            self.clean += 1
+            if self.clean >= self.rejoin_after:
+                self.quarantined = False
+                self.clean = 0
+                self.blowups = 0
+            return True
+        return False
+
+
+def _stream_geometry(r) -> dict:
+    """The ONE checkpoint geometry fingerprint, shared by the single-
+    stream and fleet receivers (so a fleet lane's checkpoint restores
+    into a lone receiver): everything a restoring receiver must match
+    for bit-identical resumption — the detector parameters included,
+    since different thresholds detect different frame starts."""
+    return {"chunk_len": r.chunk_len, "frame_len": r.frame_len,
+            "k": r.k, "n_sym_bucket": r.n_sym_bucket,
+            "check_fcs": bool(r.check_fcs),
+            "threshold": r._threshold, "min_run": r._min_run,
+            "dead_zone": r._dead_zone,
+            "viterbi_window": r.viterbi_window,
+            "viterbi_metric": r.viterbi_metric,
+            "viterbi_radix": r.viterbi_radix}
+
+
+def _pull_chunk(outs):
+    """Materialize a chunk scan's per-lane scalars on the host. On an
+    ASYNC backend a runtime failure mid-execution surfaces HERE, at
+    the first host pull, not inside the guarded dispatch — callers
+    wrap this and re-run the chunk through the guarded path when it
+    throws (the launched results are lost either way). `segs` stays
+    device-resident for the decode dispatch."""
+    (own, starts, overflow, found, fstart, _eps, rb, ln, pk, nv,
+     segs) = outs
+    return (np.asarray(own), np.asarray(starts), np.asarray(overflow),
+            np.asarray(found), np.asarray(fstart), np.asarray(rb),
+            np.asarray(ln), np.asarray(pk), np.asarray(nv), segs)
+
+
+def _record_degraded(entered: bool) -> None:
+    """The ONE degrade-visibility ritual (both receivers and both
+    link sites share it, so the recording can never drift): the
+    rx.degraded_mode gauge level plus — on entry — the
+    resilience.degraded counter."""
+    from ziria_tpu.utils import dispatch, telemetry
+    dispatch.record_gauge("rx.degraded_mode", 1.0 if entered else 0.0)
+    if entered:
+        telemetry.count("resilience.degraded")
+
+
+def _guarded_decode(r, label: str, dec, *args):
+    """The ONE guarded decode dispatch + SYNCHRONOUS host pull
+    (single-stream and fleet receivers share it): an async runtime
+    failure surfaces at the pull, after the dispatch returned, so the
+    pull lives inside the same containment — one guarded re-dispatch,
+    then None, with the receiver marked degraded so the caller (and
+    the rest of the stream) runs the oracle twin. Returns (clear,
+    crc) as host arrays, or None."""
+    from ziria_tpu.runtime import resilience
+    from ziria_tpu.utils import telemetry
+
+    for attempt in (0, 1):
+        try:
+            clear, crc = resilience.guarded(label, dec, *args,
+                                            policy=r._policy)
+            return np.asarray(clear, np.uint8), np.asarray(crc)
+        except resilience.DispatchFailed:
+            break
+        except Exception:        # noqa: BLE001 - async pull loss
+            if attempt:
+                break
+            telemetry.count("resilience.async_rescans")
+    r._mark_degraded(scan=False)
+    return None
+
+
+def _gate_finite(arr: np.ndarray, name: str, sanitize: bool,
+                 health: "_LaneHealth"):
+    """The ONE non-finite gate behind the shape gate (single-stream
+    and fleet push seams share it, so the two can never drift):
+    reject with an error NAMING the stream — or, under
+    ``sanitize=True``, zero the poisoned samples and quarantine the
+    lane. Returns ``(arr, n_bad)``; the caller owns its own dirty
+    flag and sanitized counter."""
+    if arr.size == 0:
+        return arr, 0
+    bad = ~np.isfinite(arr)
+    if not bad.any():
+        return arr, 0
+    n_bad = int(bad.any(axis=-1).sum())
+    if not sanitize:
+        raise ValueError(
+            f"{name}: pushed slab carries {n_bad} non-finite "
+            f"sample(s); reject at the source or construct the "
+            f"receiver with sanitize=True to zero-and-quarantine")
+    arr = np.where(bad, np.float32(0), arr)
+    health.poison()
+    from ziria_tpu.utils import telemetry
+    telemetry.count("resilience.sanitized", n_bad)
+    return arr, n_bad
+
+
 class StreamStats(NamedTuple):
     chunks: int                # chunk dispatch-1 scans issued
     frames: int                # StreamFrames emitted
     overflow_chunks: int       # chunks reporting > K eligible plateaus
     max_in_flight: int         # high-water chunk dispatches in flight
+    sanitized: int = 0         # non-finite samples zeroed (sanitize=True)
+    quarantines: int = 0       # times the stream entered quarantine
+    lane_blowups: int = 0      # per-window oracle decode blowups caught
+    degraded: bool = False     # a compiled program degraded to its twin
 
 
 class StreamReceiver:
@@ -484,9 +656,15 @@ class StreamReceiver:
                  dead_zone: int = 320, viterbi_window: int = None,
                  viterbi_metric: str = None,
                  viterbi_radix: int = None,
-                 streaming: Optional[bool] = None):
+                 streaming: Optional[bool] = None,
+                 sanitize: bool = False,
+                 max_retries: Optional[int] = None,
+                 watchdog_s: Optional[float] = None,
+                 blowup_limit: int = 2, rejoin_after: int = 3,
+                 checkpoint: Optional[bytes] = None):
         from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
+        from ziria_tpu.runtime import resilience
 
         if frame_len != _rx._stream_bucket(frame_len):
             raise ValueError(
@@ -514,9 +692,23 @@ class StreamReceiver:
         # stream's fixed compiled geometry (decode jit cache key)
         self.viterbi_radix = _check_radix(viterbi_radix)
         self.streaming = streaming_rx_enabled(streaming)
+        # detector params kept for the degraded eager twin (the same
+        # chunk graph run op-by-op when the compiled program fails)
+        self._threshold = float(threshold)
+        self._min_run = int(min_run)
+        self._dead_zone = int(dead_zone)
         self._jit1 = _rx._jit_stream_chunk(
             self.k, self.frame_len, self.n_sym_bucket,
             float(threshold), int(min_run), int(dead_zone))
+        self.sanitize = bool(sanitize)
+        self._policy = resilience.default_policy(
+            max_retries=max_retries, timeout_s=watchdog_s)
+        self._health = _LaneHealth(blowup_limit, rejoin_after)
+        self._dirty = False        # non-finite input since last chunk
+        self._sanitized = 0
+        self._lane_blowups = 0
+        self._degraded = False        # decode program -> oracle twin
+        self._scan_degraded = False   # chunk program -> eager twin
         self._tail = np.zeros((0, 2), np.float32)
         self._offset = 0
         self._emitted = 0
@@ -528,6 +720,44 @@ class StreamReceiver:
         self._overflow_chunks = 0
         self._max_in_flight = 0
         self._flushed = False
+        if checkpoint is not None:
+            st = resilience.restore_carry(checkpoint)
+            mine = self._geometry()
+            missing = [k_ for k_ in mine if k_ not in st.geometry]
+            if missing:
+                # a blob with a partial/empty fingerprint (a raw
+                # checkpoint_carry without geometry) must not restore
+                # into an arbitrary receiver: refuse to guess
+                raise resilience.CarryCheckpointError(
+                    f"checkpoint lacks geometry fields {missing}; "
+                    f"use StreamReceiver.checkpoint() (or pass the "
+                    f"receiver geometry to checkpoint_carry) so the "
+                    f"restore can be validated")
+            bad = {k_: (st.geometry[k_], mine[k_]) for k_ in mine
+                   if st.geometry[k_] != mine[k_]}
+            if bad:
+                raise resilience.CarryCheckpointError(
+                    f"checkpoint geometry mismatch (checkpoint, "
+                    f"receiver): {bad}")
+            self._tail = np.asarray(st.tail, np.float32)
+            self._offset = int(st.offset)
+            self._emitted = int(st.emitted)
+            self._watermark = int(st.watermark)
+            self._seen = set(st.seen)
+            rs = st.state   # quarantine/degraded runtime state: a
+            #                 quarantined receiver must RESUME
+            #                 quarantined or emissions diverge from
+            #                 the uninterrupted run
+            self._health.quarantined = bool(rs.get("quarantined",
+                                                   False))
+            self._health.clean = int(rs.get("clean", 0))
+            self._health.blowups = int(rs.get("blowups", 0))
+            self._health.quarantines = int(rs.get("quarantines", 0))
+            self._dirty = bool(rs.get("dirty", False))
+            self._sanitized = int(rs.get("sanitized", 0))
+            self._lane_blowups = int(rs.get("lane_blowups", 0))
+            self._degraded = bool(rs.get("degraded", False))
+            self._scan_degraded = bool(rs.get("scan_degraded", False))
 
     # -- state ----------------------------------------------------------
 
@@ -539,24 +769,77 @@ class StreamReceiver:
     @property
     def stats(self) -> StreamStats:
         return StreamStats(self._chunks, self._emitted,
-                           self._overflow_chunks, self._max_in_flight)
+                           self._overflow_chunks, self._max_in_flight,
+                           self._sanitized, self._health.quarantines,
+                           self._lane_blowups,
+                           self._degraded or self._scan_degraded)
+
+    def _geometry(self) -> dict:
+        return _stream_geometry(self)
+
+    def _runtime_state(self) -> dict:
+        """The checkpoint's runtime-state rider: quarantine health +
+        degraded flags + containment counters, so a restored receiver
+        keeps behaving exactly as the uninterrupted one would."""
+        return {"quarantined": self._health.quarantined,
+                "clean": self._health.clean,
+                "blowups": self._health.blowups,
+                "quarantines": self._health.quarantines,
+                "dirty": self._dirty,
+                "sanitized": self._sanitized,
+                "lane_blowups": self._lane_blowups,
+                "degraded": self._degraded,
+                "scan_degraded": self._scan_degraded}
+
+    def checkpoint(self):
+        """Serialize the live stream state (runtime/resilience
+        checkpoint blob): the in-flight chunk is DRAINED first — its
+        frames belong to the pre-checkpoint past and are returned
+        alongside, so nothing launched is silently dropped. The blob
+        carries the quarantine/degraded runtime state too. Returns
+        ``(state_bytes, frames)``; a new
+        ``StreamReceiver(checkpoint=state_bytes, ...)`` at the same
+        geometry resumes with bit-identical subsequent emissions."""
+        if self._flushed:
+            raise RuntimeError("checkpoint after flush")
+        out: List[StreamFrame] = []
+        if self._pending is not None:
+            pend, self._pending = self._pending, None
+            out = self._drain(pend)
+        from ziria_tpu.runtime import resilience
+        return resilience.checkpoint_carry(
+            self.carry, seen=self._seen, geometry=self._geometry(),
+            state=self._runtime_state()), out
 
     # -- the push surface -----------------------------------------------
 
     def push(self, samples) -> List[StreamFrame]:
         """Append samples ((n, 2) float pairs) to the stream; scan
-        every full chunk that completes. Returns the frames emitted."""
+        every full chunk that completes. Returns the frames emitted.
+        Malformed slabs fail loudly at the seam (`_slab_array`);
+        non-finite samples reject — or, with ``sanitize=True``, zero
+        and quarantine the stream (docs/robustness.md)."""
         if self._flushed:
             raise RuntimeError("push after flush")
-        arr = np.asarray(samples, np.float32)
+        from ziria_tpu.utils import dispatch, faults
+
+        arr = _slab_array(samples, "stream")
+        arr, _kinds = faults.corrupt_slab("rx.push", arr)
+        arr, n_bad = _gate_finite(arr, "stream", self.sanitize,
+                                  self._health)
+        if n_bad:
+            self._sanitized += n_bad
+            self._dirty = True
         if arr.size:
             self._tail = np.concatenate([self._tail, arr], axis=0)
-        from ziria_tpu.utils import dispatch
 
         out: List[StreamFrame] = []
         while self._tail.shape[0] >= self.chunk_len:
+            q = self._health.step(self._dirty)
+            self._dirty = False
             out += self._launch(self._tail[:self.chunk_len],
-                                self.chunk_len, self.stride)
+                                0 if q else self.chunk_len,
+                                self.stride)
             self._tail = self._tail[self.stride:]
             self._offset += self.stride
             # carry depth after each chunk consumption: with telemetry
@@ -577,9 +860,11 @@ class StreamReceiver:
         out: List[StreamFrame] = []
         valid = self._tail.shape[0]
         if valid:
+            q = self._health.step(self._dirty)
+            self._dirty = False
             arr = np.zeros((self.chunk_len, 2), np.float32)
             arr[:valid] = self._tail
-            out += self._launch(arr, valid, valid)
+            out += self._launch(arr, 0 if q else valid, valid)
         if self._pending is not None:
             pend, self._pending = self._pending, None
             out += self._drain(pend)
@@ -606,15 +891,54 @@ class StreamReceiver:
         chunk_args = (dev, jnp.int32(valid), jnp.int32(own_lo),
                       jnp.int32(own_hi))
         programs.note_site("rx.stream_chunk", self._jit1, *chunk_args)
-        with dispatch.timed("rx.stream_chunk"):
-            outs = self._jit1(*chunk_args)
+        outs = self._scan_dispatch(chunk_args)
+        dispatch.record_gauge(
+            "rx.degraded_mode",
+            1.0 if (self._degraded or self._scan_degraded) else 0.0)
+        dispatch.record_gauge(
+            "rx.quarantined_streams",
+            1.0 if self._health.quarantined else 0.0)
         self._chunks += 1
         self._inflight += 1
         self._max_in_flight = max(self._max_in_flight, self._inflight)
         dispatch.record_gauge("rx.stream_inflight", self._inflight)
         pend, self._pending = self._pending, (self._offset, arr, valid,
-                                              outs)
+                                              own_hi, outs)
         return self._drain(pend) if pend is not None else []
+
+    def _scan_dispatch(self, chunk_args):
+        """The ONE guarded chunk-scan dispatch (shared by `_launch`
+        and the async-rescan path): the compiled program behind the
+        guard, degrading to the eager twin when it fails for good."""
+        from ziria_tpu.runtime import resilience
+
+        if self._scan_degraded:
+            return self._eager_chunk(*chunk_args)
+        try:
+            return resilience.guarded(
+                "rx.stream_chunk", self._jit1, *chunk_args,
+                policy=self._policy)
+        except resilience.DispatchFailed:
+            self._mark_degraded(scan=True)
+            return self._eager_chunk(*chunk_args)
+
+
+    def _rescan(self, arr, valid: int, off: int, own_hi: int):
+        """Re-run a chunk whose ASYNC results were lost: a runtime
+        failure mid-execution surfaces at the host pull in `_drain`,
+        after the guarded dispatch already returned — the launched
+        results are gone, so the chunk re-dispatches through the same
+        guarded/degraded path (counted as an async rescan)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ziria_tpu.utils import telemetry
+
+        telemetry.count("resilience.async_rescans")
+        own_lo = -192 if off == 0 else 0
+        return self._scan_dispatch(
+            (jax.device_put(arr), jnp.int32(valid),
+             jnp.int32(own_lo), jnp.int32(own_hi)))
 
     def _drain(self, pend) -> List[StreamFrame]:
         """Block on a launched chunk's per-lane scalars, run the host
@@ -625,42 +949,29 @@ class StreamReceiver:
         from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
         from ziria_tpu.utils import dispatch, programs
 
-        off, arr, valid, outs = pend
-        (own, starts, overflow, found, fstart, eps, rb, ln, pk, nv,
-         segs) = outs
-        own = np.asarray(own)
-        starts = np.asarray(starts)
-        found = np.asarray(found)
-        fstart = np.asarray(fstart)
-        rb = np.asarray(rb)
-        ln = np.asarray(ln)
-        pk = np.asarray(pk)
-        nv = np.asarray(nv)
+        off, arr, valid, own_hi, outs = pend
+        try:
+            (own, starts, overflow, found, fstart, rb, ln, pk, nv,
+             segs) = _pull_chunk(outs)
+        except Exception:    # noqa: BLE001 - async loss, re-dispatch
+            (own, starts, overflow, found, fstart, rb, ln, pk, nv,
+             segs) = _pull_chunk(self._rescan(arr, valid, off,
+                                              own_hi))
         self._inflight -= 1
-        if bool(np.asarray(overflow)):
+        if bool(overflow):
             self._overflow_chunks += 1
 
         self._watermark = off
         self._seen, cands = _chunk_candidates(self._seen, off, own,
                                               starts, self.k)
 
-        if not self.streaming:
+        if not self.streaming or self._degraded:
             # the per-capture oracle: the SAME detected windows, each
             # sliced to the host and pushed through `rx.receive` — the
             # ">= 3 dispatches per frame" path the streaming mode's
-            # identity (and speedup) is measured against
-            out = []
-            for abs_start, j in cands:
-                s = int(starts[j])
-                win = arr[s: min(s + self.frame_len, valid)]
-                out.append(StreamFrame(abs_start, _rx.receive(
-                    win, check_fcs=self.check_fcs,
-                    viterbi_window=self.viterbi_window,
-                    viterbi_metric=self.viterbi_metric,
-                    viterbi_radix=self.viterbi_radix)))
-            self._emitted += len(out)
-            self._note_emitted(len(out))
-            return out
+            # identity (and speedup) is measured against, and the
+            # degraded twin when the compiled decode fails for good
+            return self._decode_oracle(cands, starts, arr, valid)
 
         emit = {}
         lanes = []                   # (abs_start, lane row, rate, len)
@@ -694,10 +1005,16 @@ class StreamReceiver:
                                          self.viterbi_radix)
             programs.note_site("rx.stream_decode", dec, segs, rows,
                                ridx, nbits, npsdu)
-            with dispatch.timed("rx.stream_decode"):
-                clear, crc = dec(segs, rows, ridx, nbits, npsdu)
-            clear = np.asarray(clear, np.uint8)
-            crc = np.asarray(crc)
+            got = _guarded_decode(
+                self, "rx.stream_decode", dec, segs, rows, ridx,
+                nbits, npsdu)
+            if got is None:
+                # the compiled decode failed for good (at dispatch OR
+                # at the async host pull): degrade to the per-capture
+                # oracle for this chunk AND the rest of the stream
+                # (bit-identical by the pinned contract)
+                return self._decode_oracle(cands, starts, arr, valid)
+            clear, crc = got
             for i, (abs_start, _j, m, _n, lb) in enumerate(lanes):
                 psdu = clear[i][N_SERVICE_BITS: N_SERVICE_BITS + 8 * lb]
                 emit[abs_start] = _rx.RxResult(
@@ -707,6 +1024,80 @@ class StreamReceiver:
         self._emitted += len(out)
         self._note_emitted(len(out))
         return out
+
+    def _decode_oracle(self, cands, starts, arr,
+                       valid: int) -> List[StreamFrame]:
+        """The per-capture decode twin over the chunk's owned windows
+        — the ``streaming=False`` oracle AND the degraded mode the
+        compiled decode falls back to. Under the resilience opt-ins
+        (``sanitize=True`` or degraded mode) a window whose
+        per-capture receive blows up is counted
+        (`resilience.lane_blowups`), dropped loudly, and charged to
+        the stream's health (repeated blowups quarantine it) — never
+        a crash, never a silent wrong answer. In the PLAIN
+        ``streaming=False`` oracle (no opt-in) exceptions propagate
+        unchanged: a genuine decoder defect must surface, not
+        masquerade as frame loss."""
+        from ziria_tpu.phy.wifi import rx as _rx
+        from ziria_tpu.utils import telemetry
+
+        contain = (self.sanitize or self._degraded
+                   or self._scan_degraded)
+        out: List[StreamFrame] = []
+        for abs_start, j in cands:
+            s = int(starts[j])
+            win = arr[s: min(s + self.frame_len, valid)]
+            try:
+                res = _rx.receive(
+                    win, check_fcs=self.check_fcs,
+                    viterbi_window=self.viterbi_window,
+                    viterbi_metric=self.viterbi_metric,
+                    viterbi_radix=self.viterbi_radix)
+            except Exception:    # noqa: BLE001 - counted containment
+                if not contain:
+                    raise
+                self._lane_blowups += 1
+                self._health.blowup()
+                telemetry.count("resilience.lane_blowups")
+                continue
+            out.append(StreamFrame(abs_start, res))
+        self._emitted += len(out)
+        self._note_emitted(len(out))
+        return out
+
+    def _eager_chunk(self, dev, valid, own_lo, own_hi):
+        """The degraded scan twin: the SAME chunk graph run op-by-op
+        (eager jax) — no dependence on the failed compiled program.
+        Slower (many small dispatches) but available; labelled
+        ``rx.stream_chunk.eager`` so chaos plans targeting the
+        compiled site never block the fallback."""
+        from ziria_tpu.phy.wifi import rx as _rx
+        from ziria_tpu.utils import dispatch
+
+        with dispatch.timed("rx.stream_chunk.eager"):
+            return _rx.stream_chunk_graph(
+                dev, valid, own_lo, own_hi, self.k, self.frame_len,
+                self.n_sym_bucket, self._threshold, self._min_run,
+                self._dead_zone)
+
+    def _mark_degraded(self, scan: bool) -> None:
+        """Enter degraded mode for one of the two compiled streaming
+        programs: recorded as the ``rx.degraded_mode`` gauge plus a
+        counter — a fleet quietly running its slow twin must be
+        visible in trace_report, not discovered in a latency graph."""
+        if scan:
+            self._scan_degraded = True
+        else:
+            self._degraded = True
+        _record_degraded(True)
+
+    def reset_degraded(self) -> None:
+        """Leave degraded mode (re-probe the compiled programs on the
+        next chunk) — the operator's lever after the underlying fault
+        (a tunnel flap, a wedged device) is known to be fixed."""
+        self._degraded = False
+        self._scan_degraded = False
+        _record_degraded(False)
 
     def _note_emitted(self, k: int) -> None:
         """Frames-emitted counter into the telemetry layer (registry
@@ -802,6 +1193,11 @@ class MultiStreamStats(NamedTuple):
     overflow_chunks: int       # per-stream chunk overflow flags raised
     max_in_flight: int         # high-water chunk-steps in flight
     max_active_streams: int    # high-water active lanes in one step
+    sanitized: int = 0         # non-finite samples zeroed, fleet-wide
+    quarantines: int = 0       # quarantine entries, fleet-wide
+    quarantined_streams: int = 0   # streams quarantined RIGHT NOW
+    lane_blowups: int = 0      # per-window oracle blowups caught
+    degraded: bool = False     # a compiled fleet program degraded
 
 
 class MultiStreamReceiver:
@@ -829,9 +1225,13 @@ class MultiStreamReceiver:
                  min_run: int = 33, dead_zone: int = 320,
                  viterbi_window: int = None, viterbi_metric: str = None,
                  viterbi_radix: int = None, mesh=None,
-                 axis: str = "dp"):
+                 axis: str = "dp", sanitize: bool = False,
+                 max_retries: Optional[int] = None,
+                 watchdog_s: Optional[float] = None,
+                 blowup_limit: int = 2, rejoin_after: int = 3):
         from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
+        from ziria_tpu.runtime import resilience
 
         if n_streams < 1:
             raise ValueError(f"n_streams {n_streams} must be >= 1")
@@ -863,16 +1263,29 @@ class MultiStreamReceiver:
         self.viterbi_radix = _check_radix(viterbi_radix)
         self.mesh = mesh
         self.axis = axis
+        self._threshold = float(threshold)
+        self._min_run = int(min_run)
+        self._dead_zone = int(dead_zone)
         self._jit1 = _rx._jit_stream_chunk_multi(
             self.k, self.frame_len, self.n_sym_bucket,
             float(threshold), int(min_run), int(dead_zone), mesh, axis)
+        self.sanitize = bool(sanitize)
+        self._policy = resilience.default_policy(
+            max_retries=max_retries, timeout_s=watchdog_s)
+        self._health = [_LaneHealth(blowup_limit, rejoin_after)
+                        for _ in range(self.s)]
+        self._dirty = [False] * self.s
+        self._sanitized = 0
+        self._lane_blowups = 0
+        self._degraded = False        # fleet decode -> oracle twin
+        self._scan_degraded = False   # fleet scan -> eager twin
         self._tails = [np.zeros((0, 2), np.float32)
                        for _ in range(self.s)]
         self._offsets = [0] * self.s
         self._emitted = [0] * self.s
         self._watermarks = [0] * self.s
         self._seen = [set() for _ in range(self.s)]
-        self._pending = None   # (offset snapshot, active, outs)
+        self._pending = None   # (offsets, active, arrs, valid, outs)
         self._inflight = 0
         self._chunk_steps = 0
         self._overflow_chunks = 0
@@ -896,42 +1309,110 @@ class MultiStreamReceiver:
 
     @property
     def stats(self) -> MultiStreamStats:
-        return MultiStreamStats(self.s, self._chunk_steps,
-                                sum(self._emitted),
-                                self._overflow_chunks,
-                                self._max_in_flight, self._max_active)
+        return MultiStreamStats(
+            self.s, self._chunk_steps, sum(self._emitted),
+            self._overflow_chunks, self._max_in_flight,
+            self._max_active, self._sanitized,
+            sum(h.quarantines for h in self._health),
+            sum(1 for h in self._health if h.quarantined),
+            self._lane_blowups,
+            self._degraded or self._scan_degraded)
+
+    def quarantined(self, stream: int) -> bool:
+        """True while `stream` rides behind the valid-mask (poisoned
+        input or repeated decode blowups; docs/robustness.md)."""
+        return self._health[stream].quarantined
+
+    def _geometry(self) -> dict:
+        return _stream_geometry(self)
+
+    def checkpoint(self, stream: int):
+        """Serialize one fleet lane's live stream state (the in-flight
+        chunk-step is drained first; its fleet-wide emissions return
+        alongside). The blob restores into a lone
+        ``StreamReceiver(checkpoint=...)`` at the same geometry —
+        a crashed fleet lane resumes on its own receiver with
+        bit-identical subsequent emissions. Returns
+        ``(state_bytes, (stream, frame) pairs)``."""
+        if self._flushed:
+            raise RuntimeError("checkpoint after flush")
+        if not 0 <= stream < self.s:
+            raise IndexError(f"stream {stream} not in [0, {self.s})")
+        out: List = []
+        if self._pending is not None:
+            pend, self._pending = self._pending, None
+            out = self._drain(pend)
+        from ziria_tpu.runtime import resilience
+        h = self._health[stream]
+        state = {"quarantined": h.quarantined, "clean": h.clean,
+                 "blowups": h.blowups, "quarantines": h.quarantines,
+                 "dirty": self._dirty[stream],
+                 "degraded": self._degraded,
+                 "scan_degraded": self._scan_degraded}
+        return resilience.checkpoint_carry(
+            self.carry(stream), seen=self._seen[stream],
+            geometry=self._geometry(), state=state), out
 
     # -- the push surface -----------------------------------------------
+
+    def _ingest(self, stream: int, samples) -> None:
+        """The per-stream push seam: shape gate, chaos corruption
+        seam (site ``rx.push.s<i>``), non-finite gate (reject, or
+        ``sanitize=True`` zero-and-quarantine), then append."""
+        from ziria_tpu.utils import faults
+
+        name = f"stream {stream}"
+        arr = _slab_array(samples, name)
+        arr, _kinds = faults.corrupt_slab(f"rx.push.s{stream}", arr)
+        arr, n_bad = _gate_finite(arr, name, self.sanitize,
+                                  self._health[stream])
+        if n_bad:
+            self._sanitized += n_bad
+            self._dirty[stream] = True
+        if arr.size:
+            self._tails[stream] = np.concatenate(
+                [self._tails[stream], arr], axis=0)
 
     def push(self, stream: int, samples) -> List:
         """Append samples ((n, 2) float pairs) to one stream; fire
         every chunk-step that completes. Returns the emitted
         ``(stream, StreamFrame)`` pairs (any stream may emit — a
-        completed step drains the previous step's emissions)."""
+        completed step drains the previous step's emissions).
+        Malformed slabs and non-finite samples fail loudly at the
+        seam, naming the stream (or quarantine under
+        ``sanitize=True``; docs/robustness.md)."""
         if self._flushed:
             raise RuntimeError("push after flush")
         if not 0 <= stream < self.s:
             raise IndexError(f"stream {stream} not in [0, {self.s})")
-        arr = np.asarray(samples, np.float32)
-        if arr.size:
-            self._tails[stream] = np.concatenate(
-                [self._tails[stream], arr], axis=0)
+        self._ingest(stream, samples)
         return self._pump()
 
     def push_many(self, slabs) -> List:
         """Append one slab per stream (empty slabs fine), THEN pump:
         streams that filled a chunk together ride the same chunk-step
-        — the packer's lockstep fast path for synchronized feeds."""
+        — the packer's lockstep fast path for synchronized feeds.
+        ``slabs`` is a length-S sequence, or a ``{stream_id: slab}``
+        dict for sparse arrival; an unknown stream id raises a named
+        KeyError."""
         if self._flushed:
             raise RuntimeError("push after flush")
-        if len(slabs) != self.s:
-            raise ValueError(f"{self.s} streams need {self.s} slabs, "
-                             f"got {len(slabs)}")
-        for i, s in enumerate(slabs):
-            arr = np.asarray(s, np.float32)
-            if arr.size:
-                self._tails[i] = np.concatenate(
-                    [self._tails[i], arr], axis=0)
+        if isinstance(slabs, dict):
+            for i in slabs:
+                if not (isinstance(i, (int, np.integer))
+                        and 0 <= int(i) < self.s):
+                    raise KeyError(
+                        f"push_many: unknown stream id {i!r} (this "
+                        f"fleet has streams 0..{self.s - 1})")
+            items = [(int(i), s) for i, s in slabs.items()]
+        else:
+            if len(slabs) != self.s:
+                raise ValueError(
+                    f"{self.s} streams need {self.s} slabs, "
+                    f"got {len(slabs)}")
+            items = list(enumerate(slabs))
+        for i, s in items:
+            self._ingest(i, s)
         return self._pump()
 
     def flush(self) -> List:
@@ -986,6 +1467,14 @@ class MultiStreamReceiver:
                 valid[i] = self.chunk_len
                 own_hi[i] = self.stride
                 adv[i] = self.stride
+            # a quarantined stream rides behind the existing valid-
+            # mask: its chunk advances (samples consumed) but the
+            # detector sees zero valid samples — healthy lanes are
+            # untouched by construction (per-lane graphs under vmap),
+            # and the <= 2-dispatch budget is preserved
+            if self._health[i].step(self._dirty[i]):
+                valid[i] = 0
+            self._dirty[i] = False
             # the stream's FIRST chunk owns head-truncated preambles
             # (start clamps to 0), exactly the single-stream rule
             own_lo[i] = -192 if self._offsets[i] == 0 else 0
@@ -1023,8 +1512,7 @@ class MultiStreamReceiver:
                       self._put(own_lo), self._put(own_hi))
         programs.note_site("rx.stream_chunk_multi", self._jit1,
                            *chunk_args)
-        with dispatch.timed("rx.stream_chunk_multi"):
-            outs = self._jit1(*chunk_args)
+        outs = self._scan_dispatch(chunk_args)
         self._chunk_steps += 1
         self._inflight += 1
         self._max_in_flight = max(self._max_in_flight, self._inflight)
@@ -1033,8 +1521,42 @@ class MultiStreamReceiver:
         # the fleet-level time series: how many lanes carried real
         # samples this step (idle lanes are the valid-mask riders)
         dispatch.record_gauge("rx.active_streams", len(active))
-        pend, self._pending = self._pending, (offs, list(active), outs)
+        dispatch.record_gauge(
+            "rx.quarantined_streams",
+            float(sum(1 for h in self._health if h.quarantined)))
+        dispatch.record_gauge(
+            "rx.degraded_mode",
+            1.0 if (self._degraded or self._scan_degraded) else 0.0)
+        pend, self._pending = self._pending, (
+            offs, list(active), arrs, valid.copy(), own_lo.copy(),
+            own_hi.copy(), outs)
         return self._drain(pend) if pend is not None else []
+
+    def _scan_dispatch(self, chunk_args):
+        """The ONE guarded fleet-scan dispatch (shared by `_launch`
+        and the async-rescan path), degrading to the eager twin when
+        the compiled program fails for good."""
+        from ziria_tpu.runtime import resilience
+
+        if self._scan_degraded:
+            return self._eager_chunk(*chunk_args)
+        try:
+            return resilience.guarded(
+                "rx.stream_chunk_multi", self._jit1, *chunk_args,
+                policy=self._policy)
+        except resilience.DispatchFailed:
+            self._mark_degraded(scan=True)
+            return self._eager_chunk(*chunk_args)
+
+    def _rescan(self, arrs, valid, own_lo, own_hi):
+        """Re-run a chunk-step whose ASYNC results were lost at the
+        host pull (the fleet twin of StreamReceiver._rescan)."""
+        from ziria_tpu.utils import telemetry
+
+        telemetry.count("resilience.async_rescans")
+        return self._scan_dispatch(
+            (self._put(arrs), self._put(valid), self._put(own_lo),
+             self._put(own_hi)))
 
     def _drain(self, pend) -> List:
         """Block on a launched chunk-step's per-lane scalars, run the
@@ -1046,38 +1568,41 @@ class MultiStreamReceiver:
         from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, RATES
         from ziria_tpu.utils import dispatch, programs
 
-        offs, active, outs = pend
-        (own, starts, overflow, found, fstart, eps, rb, ln, pk, nv,
-         segs) = outs
-        own = np.asarray(own)
-        starts = np.asarray(starts)
-        overflow = np.asarray(overflow)
-        found = np.asarray(found)
-        fstart = np.asarray(fstart)
-        rb = np.asarray(rb)
-        ln = np.asarray(ln)
-        pk = np.asarray(pk)
-        nv = np.asarray(nv)
+        offs, active, arrs, valids, own_lo, own_hi, outs = pend
+        try:
+            (own, starts, overflow, found, fstart, rb, ln, pk, nv,
+             segs) = _pull_chunk(outs)
+        except Exception:    # noqa: BLE001 - async loss, re-dispatch
+            (own, starts, overflow, found, fstart, rb, ln, pk, nv,
+             segs) = _pull_chunk(self._rescan(arrs, valids, own_lo,
+                                              own_hi))
         self._inflight -= 1
         self._overflow_chunks += int(overflow[active].sum())
 
-        emit = {}            # (stream, abs_start) -> RxResult
-        lanes = []           # (stream, abs_start, row j, rate, n_sym, lb)
+        allcands = []        # (stream, abs_start, row j) in emit order
         for i in active:
             off = offs[i]
             self._watermarks[i] = off
             self._seen[i], cands = _chunk_candidates(
                 self._seen[i], off, own[i], starts[i], self.k)
-            for abs_start, j in cands:
-                avail = int(nv[i, j]) - int(fstart[i, j])
-                res, ok = _rx._classify_acquire(
-                    bool(found[i, j]), avail, int(rb[i, j]),
-                    int(ln[i, j]), bool(pk[i, j]))
-                if ok is None:
-                    emit[(i, abs_start)] = res
-                else:
-                    lanes.append((i, abs_start, j, ok[0], ok[1],
-                                  int(ln[i, j])))
+            allcands += [(i, abs_start, j) for abs_start, j in cands]
+        if self._degraded:
+            # compiled fleet decode already failed for good: the
+            # per-capture oracle twin serves every window
+            return self._decode_oracle(allcands, starts, arrs, valids)
+
+        emit = {}            # (stream, abs_start) -> RxResult
+        lanes = []           # (stream, abs_start, row j, rate, n_sym, lb)
+        for i, abs_start, j in allcands:
+            avail = int(nv[i, j]) - int(fstart[i, j])
+            res, ok = _rx._classify_acquire(
+                bool(found[i, j]), avail, int(rb[i, j]),
+                int(ln[i, j]), bool(pk[i, j]))
+            if ok is None:
+                emit[(i, abs_start)] = res
+            else:
+                lanes.append((i, abs_start, j, ok[0], ok[1],
+                              int(ln[i, j])))
         if lanes:
             # (S, K) row tables, zero-filled past each stream's real
             # lanes (ridx 0 / nbits 0 = a full-erasure pad decode —
@@ -1103,10 +1628,15 @@ class MultiStreamReceiver:
             dec_args = (segs, self._put(rows), self._put(ridx),
                         self._put(nbits), self._put(npsdu))
             programs.note_site("rx.stream_decode_multi", dec, *dec_args)
-            with dispatch.timed("rx.stream_decode_multi"):
-                clear_d, crc_d = dec(*dec_args)
-            clear = np.asarray(clear_d, np.uint8)
-            crc = np.asarray(crc_d)
+            got = _guarded_decode(self, "rx.stream_decode_multi",
+                                  dec, *dec_args)
+            if got is None:
+                # degrade the WHOLE fleet's decode to the per-capture
+                # oracle (bit-identical by the pinned contract), this
+                # chunk-step included — healthy lanes keep flowing
+                return self._decode_oracle(allcands, starts, arrs,
+                                           valids)
+            clear, crc = got
             for i, sl in slots.items():
                 for pos, (abs_start, m, lb) in enumerate(sl):
                     psdu = clear[i, pos][
@@ -1124,6 +1654,69 @@ class MultiStreamReceiver:
             telemetry.count("rx.stream_frames", len(out),
                             total=sum(self._emitted))
         return out
+
+    def _decode_oracle(self, allcands, starts, arrs, valids) -> List:
+        """The fleet's per-capture decode twin (degraded mode): each
+        owned window sliced from its stream's host chunk and pushed
+        through per-capture `rx.receive` — the single-stream oracle
+        rule, per lane. A window whose receive blows up is counted,
+        dropped loudly, and charged to ITS stream's health (repeated
+        blowups quarantine that stream; the rest of the fleet keeps
+        flowing). Reached only from degraded mode (this path IS the
+        resilience opt-in), so containment always applies here."""
+        from ziria_tpu.phy.wifi import rx as _rx
+        from ziria_tpu.utils import telemetry
+
+        out: List = []
+        for i, abs_start, j in sorted(allcands,
+                                      key=lambda c: (c[0], c[1])):
+            s = int(starts[i, j])
+            win = arrs[i][s: min(s + self.frame_len, int(valids[i]))]
+            try:
+                res = _rx.receive(
+                    win, check_fcs=self.check_fcs,
+                    viterbi_window=self.viterbi_window,
+                    viterbi_metric=self.viterbi_metric,
+                    viterbi_radix=self.viterbi_radix)
+            except Exception:    # noqa: BLE001 - counted containment
+                self._lane_blowups += 1
+                self._health[i].blowup()
+                telemetry.count("resilience.lane_blowups")
+                continue
+            out.append((i, StreamFrame(abs_start, res)))
+            self._emitted[i] += 1
+        if out:
+            telemetry.count("rx.stream_frames", len(out),
+                            total=sum(self._emitted))
+        return out
+
+    def _eager_chunk(self, chunks, valid, own_lo, own_hi):
+        """The degraded fleet scan: the SAME stream-axis graph run
+        op-by-op (eager vmap, unsharded — results are bit-identical
+        on any mesh, so dropping the mesh in the degraded twin loses
+        throughput, never correctness)."""
+        from ziria_tpu.phy.wifi import rx as _rx
+        from ziria_tpu.utils import dispatch
+
+        with dispatch.timed("rx.stream_chunk_multi.eager"):
+            return _rx.multi_stream_chunk_graph(
+                chunks, valid, own_lo, own_hi, self.k, self.frame_len,
+                self.n_sym_bucket, self._threshold, self._min_run,
+                self._dead_zone)
+
+    def _mark_degraded(self, scan: bool) -> None:
+        if scan:
+            self._scan_degraded = True
+        else:
+            self._degraded = True
+        _record_degraded(True)
+
+    def reset_degraded(self) -> None:
+        """Leave degraded mode (re-probe the compiled fleet programs
+        on the next chunk-step)."""
+        self._degraded = False
+        self._scan_degraded = False
+        _record_degraded(False)
 
 
 def receive_streams(streams, chunk_len: int = 1 << 13,

@@ -56,6 +56,15 @@ from ziria_tpu.utils import dispatch, programs
 from ziria_tpu.utils.dispatch import pad_lanes, pow2_ceil
 
 
+def _note_link_degraded(counter: str) -> None:
+    """The ONE link-side degrade-visibility ritual (the fused link
+    and the sweep share it, so the recording can never drift): the
+    ``link.degraded_mode`` gauge plus the per-site degrade counter."""
+    from ziria_tpu.utils import telemetry
+    dispatch.record_gauge("link.degraded_mode", 1.0)
+    telemetry.count(counter)
+
+
 def batched_tx_enabled(batched_tx: Optional[bool] = None) -> bool:
     """The ONE reading of the --batched-tx / ZIRIA_BATCHED_TX knob
     (default ON), shared by every TX-batch surface."""
@@ -307,6 +316,8 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
     fused decode geometry would diverge from the staged one, so the
     whole batch falls back to the staged oracle; the common case pays
     nothing for the guard."""
+    from ziria_tpu.runtime import resilience
+
     fn = _jit_fused_link(geo.rows, geo.bit_b, geo.sym_b, geo.l_cap,
                          viterbi_window, viterbi_metric, viterbi_radix)
     fused_args = (
@@ -316,13 +327,35 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
         jnp.asarray(geo.dly), jnp.uint32(seed),
         jnp.asarray(geo.ndata_b))
     programs.note_site("link.fused", fn, *fused_args)
-    with dispatch.timed("link.fused"):
-        status, mbps_sig, len_sig, nsym_sig, clear, crc_ok = fn(
-            *fused_args)
-    status = np.asarray(status)
-    mbps_sig = np.asarray(mbps_sig)
-    len_sig = np.asarray(len_sig)
-    nsym_sig = np.asarray(nsym_sig)
+    try:
+        # guarded dispatch (runtime/resilience): a transient failure
+        # retries with backoff to the identical result (the graph is
+        # pure); a fatal or retry-exhausted one degrades the batch to
+        # the staged oracle below — bit-identical by the pinned
+        # fused-vs-staged contract, recorded, never a crash
+        status, mbps_sig, len_sig, nsym_sig, clear, crc_ok = \
+            resilience.guarded("link.fused", fn, *fused_args)
+    except resilience.DispatchFailed:
+        _note_link_degraded("link.fused_degraded")
+        return _loopback_staged(geo, seed, check_fcs, viterbi_window,
+                                viterbi_metric, viterbi_radix)
+    try:
+        # on an async backend a mid-execution runtime failure
+        # surfaces HERE at the host pull, after the guarded dispatch
+        # already returned — the fused batch is lost, so degrade
+        # exactly as for a fatal dispatch
+        status = np.asarray(status)
+        mbps_sig = np.asarray(mbps_sig)
+        len_sig = np.asarray(len_sig)
+        nsym_sig = np.asarray(nsym_sig)
+    except Exception:        # noqa: BLE001 - async loss, degrade
+        _note_link_degraded("link.fused_degraded")
+        return _loopback_staged(geo, seed, check_fcs, viterbi_window,
+                                viterbi_metric, viterbi_radix)
+    # healthy pass: re-record the gauge LEVEL so a past degrade does
+    # not latch forever on dashboards (the rx receivers' per-chunk
+    # level discipline)
+    dispatch.record_gauge("link.degraded_mode", 0.0)
 
     results: List = [None] * geo.n
     clear_np = None
@@ -348,8 +381,14 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
                                     viterbi_window, viterbi_metric,
                                     viterbi_radix)
         if clear_np is None:
-            clear_np = np.asarray(clear, np.uint8)
-            crc_np = np.asarray(crc_ok) if check_fcs else None
+            try:
+                clear_np = np.asarray(clear, np.uint8)
+                crc_np = np.asarray(crc_ok) if check_fcs else None
+            except Exception:    # noqa: BLE001 - async loss, degrade
+                _note_link_degraded("link.fused_degraded")
+                return _loopback_staged(geo, seed, check_fcs,
+                                        viterbi_window, viterbi_metric,
+                                        viterbi_radix)
         psdu = clear_np[i][N_SERVICE_BITS: N_SERVICE_BITS + 8 * ln]
         crc = bool(crc_np[i]) if check_fcs else None
         results[i] = rx.RxResult(True, m, ln, psdu, crc)
@@ -575,6 +614,17 @@ def _jit_sweep_ber(rates_key: tuple, n_bytes: int, donate: bool):
     return jax.jit(f, donate_argnums=(3,) if donate else ())
 
 
+def _sweep_dispatch(sweep_fn, bits_d, snr_d, seed_d, n_points: int,
+                    n_rates: int):
+    """One guarded sweep attempt. The error-count carry is DONATED on
+    non-CPU backends, so it must be allocated fresh per attempt — a
+    retry after a mid-execution transient would otherwise re-pass a
+    donated (hence deleted) buffer and turn every retryable failure
+    fatal."""
+    errbuf = jnp.zeros((n_points, n_rates), jnp.int32)
+    return sweep_fn(bits_d, snr_d, seed_d, errbuf)
+
+
 def sweep_ber(psdus, rates_mbps: Sequence[int],
               snr_grid: Sequence[float], seeds: Sequence[int],
               _shard=None) -> np.ndarray:
@@ -602,23 +652,73 @@ def sweep_ber(psdus, rates_mbps: Sequence[int],
     snr_flat = np.repeat(snrs, seed_arr.shape[0])
     seed_flat = np.tile(seed_arr, snrs.shape[0])
     n_points = snr_flat.shape[0]
-    errbuf = jnp.zeros((n_points, len(rates_key)), jnp.int32)
+    # shape/dtype witness for note_site only (the REAL donated carry
+    # is allocated fresh per attempt inside _sweep_dispatch): a host
+    # array carries the aval without a wasted device allocation
+    errbuf = np.zeros((n_points, len(rates_key)), np.int32)
     bits_d = jnp.asarray(bits)
     if _shard is not None:
         bits_d = _shard(bits_d)
     donate = jax.devices()[0].platform != "cpu"   # no-op (+warn) on CPU
     sweep_fn = _jit_sweep_ber(rates_key, n_bytes, donate)
-    sweep_args = (bits_d, jnp.asarray(snr_flat),
-                  jnp.asarray(seed_flat), errbuf)
-    programs.note_site("link.sweep", sweep_fn, *sweep_args)
-    with dispatch.timed("link.sweep"):
-        out = sweep_fn(*sweep_args)
+    snr_d = jnp.asarray(snr_flat)
+    seed_d = jnp.asarray(seed_flat)
+    programs.note_site("link.sweep", sweep_fn, bits_d, snr_d, seed_d,
+                       errbuf)
+    from ziria_tpu.runtime import resilience
+    try:
+        # guarded (runtime/resilience): transient failures retry to
+        # the identical counts (pure graph, fixed keys); a fatal one
+        # degrades to the python loop of per-batch link steps — the
+        # pinned integer-identical twin (test_link_fused), recorded.
+        # The dispatch wrapper allocates the DONATED carry buffer
+        # fresh per attempt: a retry after a mid-execution failure
+        # must not re-pass a donated (hence deleted) buffer
+        out = resilience.guarded(
+            "link.sweep", _sweep_dispatch, sweep_fn, bits_d, snr_d,
+            seed_d, n_points, len(rates_key))
+    except resilience.DispatchFailed:
+        _note_link_degraded("link.sweep_degraded")
+        return _sweep_ber_loop(psdus, rates_key, snr_flat, seed_flat,
+                               bits, snrs.shape[0], seed_arr.shape[0])
     # host pull outside the timed block (jaxlint R2): the site times
-    # the dispatch, not the device wait
-    errs = np.asarray(out, np.int64)
+    # the dispatch, not the device wait. On an async backend a
+    # mid-execution failure surfaces at THIS pull — one guarded
+    # re-dispatch (fresh donated buffer), then the loop twin
+    try:
+        errs = np.asarray(out, np.int64)
+    except Exception:            # noqa: BLE001 - async loss
+        try:
+            out = resilience.guarded(
+                "link.sweep", _sweep_dispatch, sweep_fn, bits_d,
+                snr_d, seed_d, n_points, len(rates_key))
+            errs = np.asarray(out, np.int64)
+        except Exception:        # noqa: BLE001 - degrade to the loop
+            _note_link_degraded("link.sweep_degraded")
+            return _sweep_ber_loop(psdus, rates_key, snr_flat,
+                                   seed_flat, bits, snrs.shape[0],
+                                   seed_arr.shape[0])
+    dispatch.record_gauge("link.degraded_mode", 0.0)   # healthy pass
     return np.transpose(
         errs.reshape(snrs.shape[0], seed_arr.shape[0],
                      len(rates_key)), (2, 0, 1))
+
+
+def _sweep_ber_loop(psdus, rates_key, snr_flat, seed_flat, bits,
+                    n_snrs: int, n_seeds: int) -> np.ndarray:
+    """The sweep's degraded twin: the python loop of per-batch
+    `loopback_ber_bits` steps over the same (snr, seed) points — the
+    exact loop `sweep_ber` is pinned integer-identical against. ~3
+    host round trips per point instead of one total, but counts are
+    bit-identical; used only when the compiled sweep fails for good."""
+    n_rates = len(rates_key)
+    errs = np.zeros((len(snr_flat), n_rates), np.int64)
+    for p, (snr, seed) in enumerate(zip(snr_flat, seed_flat)):
+        for r, m in enumerate(rates_key):
+            got = loopback_ber_bits(psdus, m, float(snr), int(seed))
+            errs[p, r] = int((got != bits).sum())
+    return np.transpose(
+        errs.reshape(n_snrs, n_seeds, n_rates), (2, 0, 1))
 
 
 def sweep_ber_sharded(psdus, rates_mbps: Sequence[int],

@@ -237,6 +237,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "(power-of-two buckets, p50/p99 bounds), "
                         "gauges — to stderr at exit (utils/telemetry; "
                         "docs/observability.md)")
+    p.add_argument("--chaos", metavar="SPEC",
+                   help="run this invocation under a seeded fault-"
+                        "injection plan (utils/faults; "
+                        "docs/robustness.md): semicolon-separated "
+                        "'[seed=N;]site:kind[:key=val,...]' specs — "
+                        "kinds nan_slab/truncate (push seams), "
+                        "transient/fatal/delay/hang (dispatch "
+                        "seams); selectors every=N / calls=i+j / "
+                        "p=F; deterministic by (site, seed, "
+                        "call-index) so every chaos run replays "
+                        "exactly. Also via ZIRIA_CHAOS")
+    p.add_argument("--max-retries", type=int, default=None,
+                   metavar="N",
+                   help="transient-failure retry budget of every "
+                        "guarded dispatch site (runtime/resilience "
+                        "guarded dispatch: watchdog + exponential "
+                        "backoff with deterministic jitter; default "
+                        "2). Also via ZIRIA_MAX_RETRIES")
     p.add_argument("--state-in",
                    help="resume stream state from this checkpoint "
                         "(runtime/state.py; jit backend)")
@@ -778,6 +796,26 @@ def main(argv=None) -> int:
         # S-stream fleet vs S independent single-stream receivers);
         # the value is the declared lane count, "0" disables
         overrides["ZIRIA_MULTI_STREAM"] = str(args.multi_stream)
+    if args.chaos is not None:
+        # faults.env_chaos reads this inside _main_run's shell; the
+        # scoped write keeps in-process callers from inheriting a
+        # fault plan, same as every knob above. Validate NOW so a
+        # malformed spec is a flag error, not a traceback from deep
+        # inside the run (parse_chaos_spec self-validates kinds and
+        # selectors)
+        from ziria_tpu.utils import faults as _faults
+        try:
+            _faults.parse_chaos_spec(args.chaos)
+        except ValueError as e:
+            raise SystemExit(f"--chaos: {e}")
+        overrides["ZIRIA_CHAOS"] = args.chaos
+    if args.max_retries is not None:
+        # resilience.env_max_retries reads this at guarded-site
+        # policy resolution time
+        if args.max_retries < 0:
+            raise SystemExit(
+                f"--max-retries: {args.max_retries} must be >= 0")
+        overrides["ZIRIA_MAX_RETRIES"] = str(args.max_retries)
     if args.trace:
         # telemetry.env_trace_path reads this inside _main_run; the
         # scoped write keeps in-process callers from inheriting an
@@ -804,10 +842,16 @@ def _main_run(args) -> int:
     is the one you want most); --metrics-dump collects the run's
     metrics registry and prints its Prometheus-style exposition to
     stderr at exit."""
-    from ziria_tpu.utils import telemetry
+    from ziria_tpu.utils import faults, telemetry
 
     tpath = telemetry.env_trace_path()
-    if not tpath and not args.metrics_dump:
+    try:
+        chaos = faults.env_chaos()
+    except ValueError as e:
+        # a directly-exported malformed ZIRIA_CHAOS must be a clean
+        # error, never a silent no-chaos run or a raw traceback
+        raise SystemExit(f"ZIRIA_CHAOS: {e}")
+    if not tpath and not args.metrics_dump and chaos is None:
         return _run_cmd(args)
     import contextlib
     reg = None
@@ -817,6 +861,11 @@ def _main_run(args) -> int:
                 stack.enter_context(telemetry.tracing(tpath))
             if args.metrics_dump:
                 reg = stack.enter_context(telemetry.collect())
+            if chaos is not None:
+                # the whole invocation runs under the described fault
+                # plan (utils/faults; --chaos / ZIRIA_CHAOS)
+                specs, seed = chaos
+                stack.enter_context(faults.inject(*specs, seed=seed))
             return _run_cmd(args)
     finally:
         # the crashed run's telemetry is the telemetry you want most:

@@ -542,6 +542,16 @@ def gauge_sample(label: str, value: float) -> None:
         tr.counter(label, value)
 
 
+def observe(name: str, value: float) -> None:
+    """One free-standing histogram observation (the resilience
+    layer's backoff delays): lands in every active registry's
+    ``name`` histogram. Free when nothing is collecting."""
+    if not _REGISTRIES:
+        return
+    for r in _REGISTRIES:
+        r.histogram(name).observe(value)
+
+
 def count(name: str, n: int = 1,
           total: Optional[float] = None) -> None:
     """An event counter (frames emitted, sessions admitted):
