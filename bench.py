@@ -99,6 +99,7 @@ STAGE_METRICS = {
     "streaming_rx": ("sps_streaming", "higher"),
     "multi_stream": ("sps_multi", "higher"),
     "resilience": ("faults_recovered", "higher"),
+    "serving": ("sps_serving", "higher"),
     "lint": ("findings_total", "lower"),
     "programs": ("programs_analyzed", "higher"),
     "numpy_baseline": ("sps", "higher"),
@@ -1564,6 +1565,47 @@ def _child_main(run_id):
             note(f"resilience stage failed: {e!r}")
             res_ev = {"error": repr(e)}
 
+    # ISSUE 13 tentpole evidence: the chaos SLO run of the
+    # continuous-batching SERVER (tools/rx_dispatch_bench
+    # .serving_stats) — N client sessions (NaN/flood/stall/oversize
+    # misbehavers included) over S lanes under injected
+    # transient+fatal+hang+delay dispatch faults, gating zero
+    # crashes, healthy-session bit-identity, the evict→restore
+    # round trip, exact shed/evict/admit accounting, and the
+    # ≤ 2-dispatches-per-chunk-step budget under admission churn;
+    # p50/p99 chunk latency and sustained aggregate samples/s land
+    # in the artifact. Same resumable never-fatal discipline.
+    def _serving_stage():
+        if time.time() - t0 > 0.95 * budget:
+            raise TimeoutError("skipped: child time budget")
+        cpu = os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+        ev = _load_rx_dispatch_bench().serving_stats(
+            n_sessions=6 if cpu else 12,
+            n_lanes=4 if cpu else 8,
+            frames_per_session=2 if cpu else 3)
+        note(f"serving: {ev['sessions']} sessions / {ev['lanes']} "
+             f"lanes, {ev['dispatches_per_chunk_step']} "
+             f"dispatches/chunk-step, {ev['sps_serving']:.0f} sps "
+             f"sustained, p50/p99 chunk "
+             f"{ev['chunk_latency_ms'].get('p50')}/"
+             f"{ev['chunk_latency_ms'].get('p99')} ms, "
+             f"{ev['faults_injected']} fault(s) injected, "
+             f"shed={ev['shed']} evicted={ev['evicted']} "
+             f"restored={ev['restored']}, healthy sessions "
+             f"bit-identical, zero crashes")
+        part("serving", **ev)
+        return ev
+
+    if "serving" in resume:
+        serving_ev = reuse(resume["serving"])
+        note("serving resumed from prior window")
+    else:
+        try:
+            serving_ev = _serving_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"serving stage failed: {e!r}")
+            serving_ev = {"error": repr(e)}
+
     # ISSUE 8 tentpole evidence: the jaxlint static-analysis sweep —
     # per-rule finding counts (and the suppression count) over
     # ziria_tpu/, recorded in the artifact so the trend — and any
@@ -1709,6 +1751,7 @@ def _child_main(run_id):
         "streaming_rx": stream_ev,
         "multi_stream": multi_ev,
         "resilience": res_ev,
+        "serving": serving_ev,
         "lint": lint_ev,
         "programs": prog_ev,
         "roofline": _roofline(

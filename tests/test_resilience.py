@@ -730,6 +730,92 @@ def test_checkpoint_geometry_mismatch_rejected(corpus):
         framebatch.StreamReceiver(checkpoint=b"garbage", **GEO)
 
 
+def test_checkpoint_restore_quarantined_and_degraded_emissions(corpus):
+    """The CROSS-PRODUCT rider restore (ISSUE 13 satellite): PR 12
+    pins each rider field separately; this pins the behavior of a
+    receiver that is simultaneously QUARANTINED and DEGRADED at
+    checkpoint time — the restored receiver's subsequent emissions
+    (quarantine drops, rejoin timing, oracle-twin decodes) are
+    bit-identical to the uninterrupted quarantined+degraded run."""
+    _s, _st, _fc, streams, _fs, _rc = corpus
+    stream = streams[0]          # 2nd frame ~3 chunks downstream:
+    #                              frames exist on BOTH sides of the
+    #                              quarantine rejoin
+
+    def run(split):
+        sr = framebatch.StreamReceiver(sanitize=True, rejoin_after=2,
+                                       **GEO)
+        bad = np.zeros((16, 2), np.float32)
+        bad[3] = np.nan
+        out = sr.push(bad)                   # -> quarantined
+        sr._mark_degraded(scan=False)        # -> decode oracle twin
+        if split is None:
+            out += sr.push(stream)
+        else:
+            out += sr.push(stream[:split])
+            blob, drained = sr.checkpoint()
+            out += drained
+            sr = framebatch.StreamReceiver(
+                sanitize=True, rejoin_after=2, checkpoint=blob,
+                **GEO)
+            assert sr._health.quarantined and sr._degraded
+            out += sr.push(stream[split:])
+        out += sr.flush()
+        return out, sr.stats
+
+    want, stats_c = run(None)
+    got, stats_r = run(stream.shape[0] // 2)
+    _same_frames(got, want)
+    # the rejoined tail really decoded through the oracle twin, and
+    # the quarantine dropped the head identically in both runs
+    assert stats_r.degraded and stats_c.degraded
+    assert stats_r.quarantines == stats_c.quarantines == 1
+    assert len(want) < len(_rc[0])     # quarantine dropped something
+    assert len(want) >= 1              # and the rejoin re-emitted
+
+
+def test_cross_product_blob_restores_into_fleet_lane(corpus):
+    """A quarantined+degraded session's blob restored into a FLEET
+    lane (`restore_stream`, the serving runtime's recovery path): the
+    quarantine rider restores per-lane, the degraded flags
+    deliberately do NOT transfer (they describe the old runtime's
+    compiled-program health; the degraded twin is bit-identical by
+    the pinned contract, so emissions cannot diverge), and the
+    lane-mate stays untouched."""
+    _s, _st, _fc, streams, _fs, res_c = corpus
+    stream = streams[0]
+    cut = stream.shape[0] // 2
+
+    def lone(split):
+        sr = framebatch.StreamReceiver(sanitize=True, rejoin_after=2,
+                                       **GEO)
+        bad = np.zeros((16, 2), np.float32)
+        bad[3] = np.nan
+        out = sr.push(bad)
+        sr._mark_degraded(scan=False)
+        out += sr.push(stream[:split] if split else stream)
+        return sr, out
+
+    sr_c, want = lone(None)
+    want += sr_c.flush()
+    sr, first = lone(cut)
+    blob, drained = sr.checkpoint()
+    first += drained
+
+    msr = framebatch.MultiStreamReceiver(2, sanitize=True,
+                                         rejoin_after=2, **GEO)
+    rest = msr.restore_stream(0, blob)
+    assert msr._health[0].quarantined          # rider restored
+    assert not msr._degraded and not msr._scan_degraded
+    assert not msr._health[1].quarantined      # lane-mate untouched
+    got2 = msr.push_many({0: stream[cut:], 1: streams[1]})
+    got2 += msr.flush()
+    rest += [f for i, f in got2 if i == 0]
+    _same_frames(first + rest, want)
+    # the healthy lane-mate is bit-identical to its clean fleet run
+    _same_frames([f for i, f in got2 if i == 1], res_c[1])
+
+
 def test_fleet_lane_checkpoint_restores_into_lone_receiver(corpus):
     _s, _st, _fc, streams, fstarts, res_c = corpus
     msr = framebatch.MultiStreamReceiver(4, **GEO)

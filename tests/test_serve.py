@@ -1,0 +1,518 @@
+"""Continuous-batching serving runtime (runtime/serve, ISSUE 13):
+admission control with explicit backpressure, deterministic SLO
+shedding, session eviction + checkpoint recovery, graceful drain, and
+the dispatch-budget contract — session count never enters the
+≤ 2-dispatches-per-chunk-step budget.
+
+Two test families:
+
+- STATE-MACHINE tests ride a stub receiver (no jax dispatch, no
+  compile): admission/queue/reject, backlog/oversize bounds, deadline
+  shedding under a fake clock, drain accounting, scrape format.
+- FLEET tests ride the real `MultiStreamReceiver` at the suite-shared
+  streaming geometry (chunk 4096 / window 1024 / K=8 / 12-byte+FCS
+  PSDUs, S=8 lanes — the exact compile keys test_rx_multistream and
+  test_resilience already pay for), pinning healthy-session
+  bit-identity vs lone single-stream receivers, the evict→restore
+  round trip, quarantine containment, and the dispatch budget under
+  admission/eviction churn via ``dispatch.no_recompile``.
+"""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.backend import framebatch
+from ziria_tpu.phy import link
+from ziria_tpu.runtime import resilience, serve
+from ziria_tpu.utils import dispatch, faults
+
+N_BYTES = 12
+CHUNK, FRAME_LEN, K, S = 4096, 1024, 8, 8
+GEO = dict(chunk_len=CHUNK, frame_len=FRAME_LEN,
+           max_frames_per_chunk=K, check_fcs=True)
+
+
+def _same(a, b) -> bool:
+    return (a.start == b.start and a.result.ok == b.result.ok
+            and a.result.rate_mbps == b.result.rate_mbps
+            and a.result.length_bytes == b.result.length_bytes
+            and np.array_equal(a.result.psdu_bits, b.result.psdu_bits)
+            and a.result.crc_ok == b.result.crc_ok)
+
+
+def _identical(got, want) -> None:
+    assert [f.start for f in got] == [f.start for f in want]
+    for a, b in zip(got, want):
+        assert _same(a, b)
+
+
+# ------------------------------------------------- stub (state machine)
+
+
+class _StubStats:
+    def __init__(self, chunk_steps):
+        self.chunk_steps = chunk_steps
+
+
+class _Stub:
+    """Sample-count-only receiver: one token frame per consumed
+    chunk; no device work. The serve layer must treat frames as
+    opaque, so tokens suffice."""
+
+    def __init__(self, s, chunk_len=256, frame_len=64):
+        self.s, self.chunk_len = s, chunk_len
+        self.stride = chunk_len - frame_len
+        self._tails = [0] * s
+        self._offsets = [0] * s
+        self._steps = 0
+        self.flushed = False
+        self.restored = {}
+
+    @property
+    def stats(self):
+        return _StubStats(self._steps)
+
+    def quarantined(self, i):
+        return False
+
+    def push_many(self, slabs):
+        for i, a in slabs.items():
+            self._tails[i] += int(a.shape[0])
+        out = []
+        while any(t >= self.chunk_len for t in self._tails):
+            self._steps += 1
+            for i in range(self.s):
+                if self._tails[i] >= self.chunk_len:
+                    out.append((i, ("frame", i, self._offsets[i])))
+                    self._tails[i] -= self.stride
+                    self._offsets[i] += self.stride
+        return out
+
+    def drain_pending(self):
+        return []
+
+    def flush_stream(self, i):
+        out = []
+        if self._tails[i]:
+            self._steps += 1
+            out.append((i, ("frame", i, self._offsets[i])))
+            self._tails[i] = 0
+        return out
+
+    def reset_stream(self, i):
+        self._tails[i] = 0
+        self._offsets[i] = 0
+        return []
+
+    def restore_stream(self, i, blob):
+        self.restored[i] = blob
+        return []
+
+    def checkpoint(self, i):
+        return b"blob", []
+
+    def flush(self):
+        self.flushed = True
+        return []
+
+
+def _stub_srv(n_lanes=2, clock=None, **kw):
+    cfg = serve.ServeConfig(
+        n_lanes=n_lanes, chunk_len=256, frame_len=64, queue_cap=2,
+        max_slab_samples=512, max_backlog_samples=1024,
+        retry_after_s=0.5, **kw)
+    return serve.ServeRuntime(
+        cfg, receiver=_Stub(n_lanes, 256, 64),
+        clock=clock if clock is not None else (lambda: 0.0))
+
+
+def test_admission_queue_and_backpressure():
+    with _stub_srv() as srv:
+        rs = [srv.connect(f"c{i}") for i in range(6)]
+        assert [r.admitted for r in rs] == [True, True, False, False,
+                                           False, False]
+        assert [r.queued for r in rs] == [False, False, True, True,
+                                          False, False]
+        # the reject is explicit, reasoned, and carries a
+        # deterministic retry hint scaled by the queue depth
+        assert rs[4].reason == "queue_full"
+        assert rs[4].retry_after_s == 0.5 * 3
+        assert srv.connect("c0").reason == "duplicate"
+        st = srv.stats()
+        assert (st.admitted, st.queued, st.rejected_admissions) \
+            == (2, 2, 2)
+
+
+def test_ingress_bounds_and_named_errors():
+    with _stub_srv() as srv:
+        srv.connect("a")
+        r = srv.submit("a", np.zeros((600, 2), np.float32))
+        assert not r.accepted and r.reason == "oversized"
+        ok = np.zeros((128, 2), np.float32)
+        for _ in range(8):
+            assert srv.submit("a", ok).accepted
+        r = srv.submit("a", ok)
+        assert not r.accepted and r.reason == "backlog_full" \
+            and r.retry_after_s > 0
+        with pytest.raises(KeyError, match="known sessions.*'a'"):
+            srv.submit("nobody", ok)
+        with pytest.raises(ValueError, match="'a'.*\\(n, 2\\)"):
+            srv.submit("a", np.zeros((4, 3)))
+        assert srv.stats().rejected_slabs == 2
+
+
+def test_deadline_shed_is_deterministic_and_attributed():
+    clock = [0.0]
+    with _stub_srv(clock=lambda: clock[0]) as srv:
+        srv.connect("fast", slo_s=100.0)
+        srv.connect("slow", slo_s=5.0)
+        srv.connect("queued-slow", slo_s=5.0)      # waits in queue
+        clock[0] = 6.0
+        srv.step()
+        st = srv.stats()
+        assert st.shed == 2 and st.active_sessions == 1
+        assert {(s, r) for s, r, _t in st.shed_log} == {
+            ("slow", "deadline"), ("queued-slow", "deadline_queued")}
+        assert [t for _s, _r, t in st.shed_log] == [6.0, 6.0]
+        # a shed session's submit gets its terminal reason, not a
+        # crash and not silence
+        r = srv.submit("slow", np.zeros((8, 2), np.float32))
+        assert not r.accepted and r.reason == "shed:deadline"
+        # replay: the same clock sequence sheds identically
+    clock2 = [0.0]
+    with _stub_srv(clock=lambda: clock2[0]) as srv2:
+        srv2.connect("fast", slo_s=100.0)
+        srv2.connect("slow", slo_s=5.0)
+        srv2.connect("queued-slow", slo_s=5.0)
+        clock2[0] = 6.0
+        srv2.step()
+        assert srv2.stats().shed_log == st.shed_log
+
+
+def test_drain_accounting_and_scrape():
+    with _stub_srv() as srv:
+        srv.connect("a")
+        srv.connect("b")
+        srv.connect("q1")                       # queued
+        srv.submit("a", np.zeros((300, 2), np.float32))
+        srv.step()
+        srv.drain()
+        st = srv.stats()
+        assert st.active_sessions == 0 and st.queue_depth == 0
+        assert srv._rx.flushed
+        # q1 was promoted when... no lane freed before drain: it is
+        # shed with reason "draining", attributably
+        assert ("q1", "draining") in {(s, r)
+                                      for s, r, _t in st.shed_log}
+        assert st.admitted == st.closed == 2
+        assert srv.connect("late").reason == "draining"
+        srv.drain()                             # idempotent
+        with pytest.raises(RuntimeError, match="after drain"):
+            srv.step()
+        page = srv.scrape()
+        assert "# TYPE serve_admitted counter" in page
+        assert 'serve_shed{reason="draining"}' in page
+        assert "serve_chunk_seconds" in page
+
+
+def test_rejected_reconnect_keeps_terminal_reason():
+    # a shed session whose reconnect is REJECTED (queue full) must
+    # keep answering submits with its terminal reason — the rejected
+    # connect must not erase the _gone record and turn the next
+    # submit into a KeyError
+    clock = [0.0]
+    with _stub_srv(clock=lambda: clock[0]) as srv:
+        srv.connect("doomed", slo_s=1.0)
+        srv.connect("a")
+        clock[0] = 2.0
+        srv.step()                          # sheds "doomed"
+        srv.connect("b")                    # takes the freed lane
+        srv.connect("q1")
+        srv.connect("q2")                   # queue now full (cap 2)
+        r = srv.connect("doomed")
+        assert not r.admitted and not r.queued \
+            and r.reason == "queue_full"
+        r = srv.submit("doomed", np.zeros((8, 2), np.float32))
+        assert not r.accepted and r.reason == "shed:deadline"
+
+
+def test_queued_close_evict_keep_accounting_balance():
+    with _stub_srv() as srv:
+        srv.connect("a")
+        srv.connect("b")
+        srv.connect("q-close")              # queued
+        srv.connect("q-evict")              # queued
+        srv.close("q-close")
+        blob, ems, _staged = srv.evict("q-evict")
+        assert blob is None and ems == []
+        st = srv.stats()
+        # the queued terminations ride their own counters: the
+        # admitted balance never counts a session it never admitted
+        assert st.admitted == 2 and st.closed == 0 and st.evicted == 0
+        assert srv._counter_total("serve.closed_queued") == 1
+        assert srv._counter_total("serve.evicted_queued") == 1
+        srv.drain()
+        st = srv.stats()
+        assert st.admitted == st.closed == 2
+
+
+def test_flood_budget_one_chunk_per_tick():
+    # the continuous-batching rate limit: one tick moves at most one
+    # chunk of a flooding client, the excess stays staged
+    with _stub_srv() as srv:
+        srv.connect("flood")
+        srv.submit("flood", np.zeros((500, 2), np.float32))
+        srv.step()
+        # chunk_len=256: exactly one chunk's worth moved, 244 staged
+        assert srv._sessions["flood"].staged_samples == 500 - 256
+        assert srv._rx.stats.chunk_steps == 1
+        srv.step()
+        assert srv._sessions["flood"].staged_samples == 0
+
+
+def test_stub_evict_restore_and_lane_recycle():
+    with _stub_srv() as srv:
+        srv.connect("a")
+        srv.submit("a", np.zeros((100, 2), np.float32))
+        blob, _ems, staged = srv.evict("a")
+        assert blob == b"blob" and len(staged) == 1
+        assert not srv.is_active("a")
+        r = srv.connect("a", checkpoint=blob)
+        assert r.admitted and srv._rx.restored[0] == b"blob"
+        st = srv.stats()
+        assert st.evicted == 1 and st.restored == 1
+
+
+# -------------------------------------------------- real-fleet corpus
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """Ten sessions' worth of mixed-rate streams with seeded ragged
+    arrival schedules, each stream's lone-receiver oracle, and one
+    clean serve pass over S=8 lanes under dispatch counters — the
+    fixture every fleet test replays against."""
+    clients = serve.synth_load(10, 2, n_bytes=N_BYTES, snr_db=30.0,
+                               seed=20260804, tail=FRAME_LEN)
+    oracle = {}
+    for c in clients:
+        oracle[c.sid], _ = framebatch.receive_stream(c.stream, **GEO)
+        assert len(oracle[c.sid]) == 2
+    cfg = serve.ServeConfig(n_lanes=S, queue_cap=10, sanitize=True,
+                            **GEO)
+    with dispatch.count_dispatches() as d:
+        with serve.ServeRuntime(cfg) as srv:
+            frames = serve.run_clients(srv, clients)
+            stats = srv.stats()
+    return clients, oracle, frames, stats, d, srv
+
+
+def test_serve_healthy_sessions_bit_identical(corpus):
+    # THE serving contract: every session's frames — multiplexed,
+    # queued, lane-recycled — equal what a lone single-stream
+    # receiver (and hence per-capture rx.receive) emits
+    clients, oracle, frames, _st, _d, _srv = corpus
+    for c in clients:
+        _identical(frames[c.sid], oracle[c.sid])
+
+
+def test_serve_accounting_balances(corpus):
+    clients, oracle, frames, st, _d, _srv = corpus
+    assert st.admitted == 10 and st.closed == 10
+    assert st.shed == st.evicted == 0
+    assert st.frames == sum(len(v) for v in oracle.values()) == 20
+    assert st.active_sessions == 0 and st.queue_depth == 0
+    # 10 sessions over 8 lanes: at least two waited in the queue
+    assert st.queued >= 2
+
+
+def test_serve_dispatch_budget_under_churn(corpus):
+    # ≤ 2 dispatches per chunk-step independent of session count,
+    # across admission/queue/close churn — and zero recompiles: the
+    # fixed (S, K, chunk) geometry is the whole point
+    clients, _oracle, _frames, st, d, _srv = corpus
+    assert d.total <= 2 * st.chunk_steps, (dict(d.counts), st)
+    from ziria_tpu.phy.wifi import rx as _rx
+    cfg = serve.ServeConfig(n_lanes=S, queue_cap=10, sanitize=True,
+                            **GEO)
+    with dispatch.no_recompile(_rx._jit_stream_chunk_multi,
+                               _rx._jit_stream_decode_multi):
+        with serve.ServeRuntime(cfg) as srv:
+            serve.run_clients(srv, clients)
+
+
+def test_serve_chunk_latency_histogram_reports(corpus):
+    *_x, srv = corpus
+    lat = srv.registry.find("serve.chunk_seconds")
+    assert lat is not None and lat.count >= 1
+    s = lat.summary(scale=1e3)
+    assert s["p50"] > 0 and s["p99"] >= s["p50"]
+    # the scrape page carries the SLO series
+    assert "serve_chunk_seconds_bucket" in srv.scrape()
+
+
+def test_serve_evict_restore_bit_identical(corpus):
+    """The acceptance round trip: a session checkpointed mid-stream
+    by the server and restored into a fresh lane emits the same
+    remaining frames as the never-evicted run."""
+    clients, oracle, _frames, _st, _d, _srv = corpus
+    a, b = clients[0], clients[1]
+    cfg = serve.ServeConfig(n_lanes=2, queue_cap=4, sanitize=True,
+                            **GEO)
+    got = {a.sid: [], b.sid: []}
+    with serve.ServeRuntime(cfg) as srv:
+        srv.connect(a.sid)
+        srv.connect(b.sid)
+        cut = a.stream.shape[0] // 2
+        for lo in range(0, cut, 1500):
+            srv.submit(a.sid, a.stream[lo: min(lo + 1500, cut)])
+            for sid, f in srv.step():
+                got[sid].append(f)
+        blob, ems, staged = srv.evict(a.sid)
+        for sid, f in ems:
+            got[sid].append(f)
+        r = srv.connect(a.sid, checkpoint=blob)
+        assert r.admitted
+        for s_ in staged:
+            srv.submit(a.sid, s_)
+        srv.submit(a.sid, a.stream[cut:])
+        srv.submit(b.sid, b.stream)
+        for _ in range(4):
+            for sid, f in srv.step():
+                got[sid].append(f)
+        for sid, f in srv.drain():
+            got[sid].append(f)
+        st = srv.stats()
+    _identical(got[a.sid], oracle[a.sid])
+    _identical(got[b.sid], oracle[b.sid])       # lane-mate untouched
+    assert st.evicted == 1 and st.restored == 1
+
+
+def test_serve_nan_client_quarantined_not_lanemates(corpus):
+    """One poisoned client never degrades its lane-mates: the NaN
+    session quarantines (drops, never garbage), every other session
+    stays bit-identical."""
+    clients, oracle, _frames, _st, _d, _srv = corpus
+    bad = serve.synth_load(4, 2, n_bytes=N_BYTES, snr_db=30.0,
+                           seed=20260804, tail=FRAME_LEN,
+                           misbehave={1: "nan"})
+    cfg = serve.ServeConfig(n_lanes=4, queue_cap=4, sanitize=True,
+                            **GEO)
+    with serve.ServeRuntime(cfg) as srv:
+        frames = serve.run_clients(srv, bad)
+    for c in bad:
+        if c.mode == "nan":
+            by_start = {f.start: f for f in oracle[c.sid]}
+            for f in frames[c.sid]:
+                assert f.start in by_start and _same(
+                    f, by_start[f.start])
+        else:
+            _identical(frames[c.sid], oracle[c.sid])
+
+
+def test_serve_chaos_zero_crashes_identical(corpus):
+    """Transient dispatch faults during a serve run: retried through
+    the guarded path, every session still bit-identical, zero
+    crashes."""
+    clients, oracle, _frames, _st, _d, _srv = corpus
+    sub = clients[:4]
+    cfg = serve.ServeConfig(n_lanes=4, queue_cap=4, sanitize=True,
+                            **GEO)
+    with faults.inject(
+            faults.FaultSpec("rx.stream_chunk_multi", "transient",
+                             every=3),
+            faults.FaultSpec("rx.stream_decode_multi", "transient",
+                             every=2), seed=5) as plan:
+        with serve.ServeRuntime(cfg) as srv:
+            frames = serve.run_clients(srv, sub)
+    assert plan.total_fired > 0
+    for c in sub:
+        _identical(frames[c.sid], oracle[c.sid])
+
+
+def test_serve_restore_refuses_geometry_mismatch(corpus):
+    clients, *_ = corpus
+    sr = framebatch.StreamReceiver(**GEO)
+    sr.push(clients[0].stream[: CHUNK // 2])
+    blob, _ = sr.checkpoint()
+    msr = framebatch.MultiStreamReceiver(2, chunk_len=2 * CHUNK,
+                                         frame_len=FRAME_LEN,
+                                         max_frames_per_chunk=K,
+                                         check_fcs=True)
+    with pytest.raises(resilience.CarryCheckpointError,
+                       match="geometry mismatch"):
+        msr.restore_stream(0, blob)
+    with pytest.raises(resilience.CarryCheckpointError):
+        msr.restore_stream(1, b"garbage")
+
+
+# ------------------------------------------- satellites: ids, arrivals
+
+
+def test_unknown_stream_ids_name_the_known_ids():
+    msr = framebatch.MultiStreamReceiver(4, **GEO)
+    for exc, call in (
+            (IndexError, lambda: msr.push(7, np.zeros((4, 2)))),
+            (IndexError, lambda: msr.push(-1, np.zeros((4, 2)))),
+            (KeyError, lambda: msr.push_many({9: np.zeros((4, 2))})),
+            (IndexError, lambda: msr.checkpoint(4)),
+            (IndexError, lambda: msr.carry(11)),
+            (IndexError, lambda: msr.quarantined(5)),
+            (IndexError, lambda: msr.flush_stream(4)),
+            (IndexError, lambda: msr.reset_stream(-2)),
+            (IndexError, lambda: msr.restore_stream(6, b"x"))):
+        with pytest.raises(exc, match=r"known\s+ids are 0\.\.3"):
+            call()
+
+
+def test_arrival_schedules_seeded_exact_and_backcompat():
+    psdus = [[np.arange(N_BYTES, dtype=np.uint8)] for _ in range(2)]
+    rates = [[6], [54]]
+    # default: the two-element return, unchanged call sites
+    out = link.stream_many_multi(psdus, rates, seed=3, add_fcs=True,
+                                 snr_db=30.0, tail=FRAME_LEN)
+    assert len(out) == 2
+    streams, starts = out
+    # arrival spec: third element, slabs concatenate back EXACTLY
+    spec = link.ArrivalSpec(slab_lo=200, slab_hi=900, gap_lo=0,
+                            gap_hi=2)
+    s2, st2, scheds = link.stream_many_multi(
+        psdus, rates, seed=3, add_fcs=True, snr_db=30.0,
+        tail=FRAME_LEN, arrival=spec)
+    assert all(np.array_equal(a, b) for a, b in zip(streams, s2))
+    for i, sched in enumerate(scheds):
+        ticks = [t for t, _s in sched]
+        assert ticks == sorted(ticks)
+        assert all(200 <= s.shape[0] < 900 for _t, s in sched[:-1])
+        cat = np.concatenate([s for _t, s in sched])
+        assert np.array_equal(cat, s2[i])
+    # seeded-deterministic: same seed, same schedule
+    _s3, _st3, scheds3 = link.stream_many_multi(
+        psdus, rates, seed=3, add_fcs=True, snr_db=30.0,
+        tail=FRAME_LEN, arrival=spec)
+    for a, b in zip(scheds, scheds3):
+        assert [t for t, _ in a] == [t for t, _ in b]
+        assert all(np.array_equal(x, y)
+                   for (_t, x), (_u, y) in zip(a, b))
+    with pytest.raises(ValueError, match="slab range"):
+        link.arrival_schedule(streams[0],
+                              link.ArrivalSpec(slab_lo=0), 1)
+
+
+def test_pushing_a_schedule_equals_pushing_the_stream():
+    # push-boundary invariance through the REAL receiver: the ragged
+    # slab schedule emits bit-identically to the whole-stream push
+    rng = np.random.default_rng(11)
+    psdus = [[rng.integers(0, 256, N_BYTES).astype(np.uint8)
+              for _ in range(2)]]
+    _s, _t, scheds = link.stream_many_multi(
+        psdus, [[24, 54]], seed=7, add_fcs=True, snr_db=30.0,
+        tail=FRAME_LEN, arrival=link.ArrivalSpec())
+    stream = np.concatenate([s for _t, s in scheds[0]])
+    want, _ = framebatch.receive_stream(stream, **GEO)
+    sr = framebatch.StreamReceiver(**GEO)
+    got = []
+    for _t, slab in scheds[0]:
+        got += sr.push(slab)
+    got += sr.flush()
+    _identical(got, want)

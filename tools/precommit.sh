@@ -27,6 +27,17 @@ if ! timeout 30 python tools/chaos_smoke.py; then
   exit 1
 fi
 
+# serve smoke (ISSUE 13): the continuous-batching server's
+# admission/backpressure/shed/evict/drain state machine exercised
+# against a stub receiver — sub-second, never imports jax (works
+# through TPU probe hangs, like chaos_smoke and the lint gate).
+if ! timeout 30 python tools/serve_smoke.py; then
+  echo "[precommit] serve smoke FAILED (tools/serve_smoke.py) —" \
+       "commit refused" >&2
+  echo "[precommit] (ZIRIA_SKIP_TESTGATE=1 to override for WIP)" >&2
+  exit 1
+fi
+
 # perf-ledger regression gate (ISSUE 9): latest vs previous
 # same-platform run in BENCH_TRAJECTORY.jsonl. Lenient tolerance —
 # bench numbers on a shared box are noisy; the gate exists to catch

@@ -969,6 +969,251 @@ def resilience_stats(n_streams=4, frames_per_stream=3, n_bytes=12,
     }
 
 
+def serving_stats(n_sessions=12, n_lanes=8, frames_per_session=3,
+                  n_bytes=12, snr_db=30.0, chunk_len=4096,
+                  frame_len=1024, k=8, seed=17):
+    """Chaos SLO run of the continuous-batching server (ISSUE 13):
+    ``n_sessions`` clients (misbehaving ones included: a NaN-slab
+    poisoner, a flood, a stall, an oversized-slab violator) served
+    over ``n_lanes`` device lanes under a deterministic fake clock —
+    three passes, all gated:
+
+    1. **budget pass** (all-healthy): dispatches ≤ 2 per chunk-step
+       independent of session count, pinned under
+       ``dispatch.no_recompile`` across admission/close churn;
+       sustained aggregate samples/s measured here.
+    2. **SLO pass** (misbehaving clients, no chaos): the stall
+       session is DEADLINE-SHED (counted, attributed), session 0 is
+       EVICTED mid-stream and restored from its checkpoint into a
+       fresh lane (bit-identical resumption — the acceptance round
+       trip), the NaN session quarantines without garbage, and every
+       healthy session's frames are bit-identical to a lone
+       single-stream receiver.
+    3. **chaos pass**: the same load under injected transient+fatal+
+       hang+delay dispatch faults — ZERO crashes, healthy sessions
+       still bit-identical, every shed/evict/restore accounted
+       exactly in the telemetry counters.
+
+    p50/p99 chunk latency (the SLO numbers) come off the server's own
+    registry (``serve.chunk_seconds`` + the per-dispatch site
+    histograms). Returns a flat dict (metric: ``sps_serving``)."""
+    import contextlib
+
+    from ziria_tpu.backend import framebatch
+    from ziria_tpu.phy.wifi import rx as _rx
+    from ziria_tpu.runtime import serve
+    from ziria_tpu.utils import dispatch, faults
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    misbehave = {1: "nan", 2: "flood", 3: "stall", 4: "oversize"}
+    clients = serve.synth_load(
+        n_sessions, frames_per_session, n_bytes=n_bytes,
+        snr_db=snr_db, seed=seed, tail=frame_len,
+        misbehave=misbehave)
+    geo = dict(chunk_len=chunk_len, frame_len=frame_len,
+               max_frames_per_chunk=k, check_fcs=True)
+    oracle = {}
+    for c in clients:
+        oracle[c.sid], _ = framebatch.receive_stream(c.stream, **geo)
+
+    def same(a, b):
+        return (a.start == b.start and a.result.ok == b.result.ok
+                and a.result.rate_mbps == b.result.rate_mbps
+                and a.result.length_bytes == b.result.length_bytes
+                and np.array_equal(a.result.psdu_bits,
+                                   b.result.psdu_bits)
+                and a.result.crc_ok == b.result.crc_ok)
+
+    stall_slo = 8.0
+    evict_sid = clients[0].sid
+
+    def drive(cs, specs=None, chaos_seed=seed, stall=True,
+              evict=True, watchdog=None):
+        # the watchdog is only armed for the chaos pass (its hang
+        # spec needs cutting): on a cold CPU cache a first-contact
+        # XLA compile legitimately exceeds any hang-scale timeout,
+        # and the earlier passes warm the caches
+        cfg = serve.ServeConfig(
+            n_lanes=n_lanes, queue_cap=n_sessions, sanitize=True,
+            default_slo_s=None, watchdog_s=watchdog, **geo)
+        clock = [0.0]
+        srv = serve.ServeRuntime(cfg, clock=lambda: clock[0])
+        frames = {c.sid: [] for c in cs}
+
+        def collect(pairs):
+            for sid, f in pairs:
+                frames[sid].append(f)
+
+        restored = not evict
+        closed = set()
+        todo = {c.sid: list(c.schedule) for c in cs}
+        pending = {c.sid: c for c in cs}
+        t0 = time.perf_counter()
+        with contextlib.ExitStack() as stack:
+            plan = stack.enter_context(
+                faults.inject(*specs, seed=chaos_seed)) \
+                if specs else None
+            stack.enter_context(srv)
+            for tick in range(400):
+                for sid in list(pending):
+                    c = pending[sid]
+                    slo = stall_slo if (stall and c.mode == "stall") \
+                        else None
+                    r = srv.connect(sid, slo_s=slo)
+                    if r.admitted or r.queued:
+                        del pending[sid]
+                for c in cs:
+                    if c.sid in pending or c.sid in closed:
+                        continue
+                    q = todo[c.sid]
+                    while q and q[0][0] <= tick:
+                        r_ = srv.submit(c.sid, q[0][1])
+                        if r_.accepted or not r_.retry_after_s:
+                            q.pop(0)
+                        else:
+                            break
+                collect(srv.step())
+                if evict and not restored and tick >= 2 \
+                        and evict_sid not in pending:
+                    blob, ems, staged = srv.evict(evict_sid)
+                    collect(ems)
+                    r = srv.connect(evict_sid, checkpoint=blob)
+                    assert r.admitted or r.queued, r
+                    for s_ in staged:
+                        srv.submit(evict_sid, s_)
+                    restored = True
+                for c in cs:
+                    if (c.sid not in pending and c.sid not in closed
+                            and not todo[c.sid] and c.mode != "stall"
+                            and (c.sid != evict_sid or restored)):
+                        if srv.is_active(c.sid):
+                            collect(srv.close(c.sid))
+                            closed.add(c.sid)
+                        elif c.sid in srv._gone:
+                            closed.add(c.sid)  # shed — accounted
+                clock[0] += 1.0
+                if (not pending and not any(todo.values())
+                        and all(c.sid in closed or c.mode == "stall"
+                                for c in cs)
+                        and (not stall or clock[0] > stall_slo + 2)):
+                    break
+            collect(srv.drain())
+        return srv, frames, time.perf_counter() - t0, plan
+
+    def gate(frames, chaos=False):
+        for c in clients:
+            got, want = frames[c.sid], oracle[c.sid]
+            if c.mode in ("nan", "stall"):
+                # poisoned/shed sessions: surviving frames match the
+                # clean run at their start — dropped, never garbage
+                by_start = {f.start: f for f in want}
+                for f in got:
+                    assert f.start in by_start and same(
+                        f, by_start[f.start]), \
+                        f"{c.sid} emitted garbage ({c.mode})"
+            else:
+                assert len(got) == len(want) and all(
+                    same(a, b) for a, b in zip(got, want)), \
+                    f"healthy session {c.sid} diverged" \
+                    f"{' under chaos' if chaos else ''}"
+
+    # -- pass 1: SLO run (misbehaving clients, shed + evict/restore).
+    # Runs first: it also pays the two fleet compiles, so the budget
+    # pass below genuinely pins zero cache growth
+    srv_s, frames_s, _t_slo, _ = drive(clients)
+    st_s = srv_s.stats()
+    gate(frames_s)
+    shed_sids = {s for s, _r, _t in st_s.shed_log}
+    assert clients[3].sid in shed_sids, "stall session was not shed"
+    assert st_s.evicted == 1 and st_s.restored == 1
+    assert st_s.rejected_slabs >= 1, "oversized slab not rejected"
+    assert st_s.admitted == st_s.closed + st_s.evicted + len(
+        [1 for _s, r, _t in st_s.shed_log if r == "deadline"]), \
+        "session accounting does not balance"
+
+    # -- pass 2: all-healthy dispatch-budget pin ------------------------
+    # the raw arrival schedules (no misbehavior rewrite), same sids,
+    # same streams: admission/close churn with every lane healthy,
+    # and the caches warmed by pass 1 — zero growth is the pin
+    healthy = serve.synth_load(
+        n_sessions, frames_per_session, n_bytes=n_bytes,
+        snr_db=snr_db, seed=seed, tail=frame_len)
+    total_samples = sum(int(c.stream.shape[0]) for c in clients)
+    with dispatch.no_recompile(_rx._jit_stream_chunk_multi,
+                               _rx._jit_stream_decode_multi):
+        with count_dispatches() as d_b:
+            srv_b, frames_b, t_budget, _ = drive(
+                healthy, stall=False, evict=False)
+    st_b = srv_b.stats()
+    assert d_b.total <= 2 * st_b.chunk_steps, \
+        (dict(d_b.counts), st_b.chunk_steps)
+    for c in healthy:
+        got, want = frames_b[c.sid], oracle[c.sid]
+        assert len(got) == len(want) and all(
+            same(a, b) for a, b in zip(got, want)), \
+            f"budget pass: session {c.sid} diverged"
+
+    # -- pass 3: chaos --------------------------------------------------
+    specs = (
+        faults.FaultSpec("rx.stream_chunk_multi", "transient",
+                         every=5),
+        faults.FaultSpec("rx.stream_decode_multi", "transient",
+                         every=4),
+        faults.FaultSpec("rx.stream_chunk_multi", "delay",
+                         calls=(3,), delay_s=0.02),
+        faults.FaultSpec("rx.stream_chunk_multi", "hang",
+                         calls=(6,), delay_s=10.0),
+        faults.FaultSpec("rx.stream_decode_multi", "fatal",
+                         calls=(2,), count=1),
+    )
+    srv_c, frames_c, _t_chaos, plan = drive(clients, specs=specs,
+                                            watchdog=2.0)
+    st_c = srv_c.stats()
+    gate(frames_c, chaos=True)       # zero crashes = reaching here
+    assert plan.total_fired > 0
+    fired_by_kind = {}
+    for _s, kind, _i in plan.fired:
+        fired_by_kind[kind] = fired_by_kind.get(kind, 0) + 1
+    snap = srv_c.registry.snapshot()
+    lat = srv_c.registry.find("serve.chunk_seconds")
+
+    return {
+        "sessions": n_sessions, "lanes": n_lanes,
+        "frames_per_session": frames_per_session,
+        "frame_bytes": n_bytes, "snr_db": snr_db,
+        "chunk_len": chunk_len, "frame_len": frame_len,
+        "stream_samples_total": total_samples,
+        "chunk_steps_budget": st_b.chunk_steps,
+        "dispatches_budget": d_b.total,
+        "dispatches_per_chunk_step": round(
+            d_b.total / max(st_b.chunk_steps, 1), 3),
+        "sps_serving": round(total_samples / t_budget, 1),
+        "t_serve_s": round(t_budget, 4),
+        "chunk_latency_ms": lat.summary(scale=1e3, ndigits=4)
+        if lat is not None else {"count": 0},
+        "p99_chunk_ms": (lat.summary(scale=1e3, ndigits=4)
+                         .get("p99") if lat is not None else None),
+        "latency_ms_sites": _latency_block(srv_c.registry),
+        "admitted": st_c.admitted, "closed": st_c.closed,
+        "shed": st_s.shed, "evicted": st_s.evicted,
+        "restored": st_s.restored,
+        "rejected_slabs": st_s.rejected_slabs,
+        "shed_log": [[s, r, t] for s, r, t in st_s.shed_log],
+        "frames_served": st_c.frames,
+        "faults_injected": plan.total_fired,
+        "faults_by_kind": fired_by_kind,
+        "retries": snap.get("resilience.retries", 0),
+        "recovered": snap.get("resilience.recovered", 0),
+        "degraded": bool(srv_c._rx.stats.degraded),
+        # from the registry, not the recycled lane health (a closed
+        # session's lane resets; the counter is the durable record)
+        "quarantines": snap.get("resilience.quarantines", 0),
+        "healthy_bit_identical": True,
+        "evict_restore_bit_identical": True,
+        "zero_crashes": True,
+    }
+
+
 def viterbi_breakdown(B=128, n_bytes=1000, rate_mbps=54, k1=4, k2=12):
     """ACS-only vs traceback-only vs front-end-only vs full decode at
     the bench shape — the answer to bench.py's open question ("the
@@ -1285,6 +1530,8 @@ def main():
             n_streams=4, frames_per_stream=2)
         out["resilience"] = resilience_stats(
             n_streams=4, frames_per_stream=2)
+        out["serving"] = serving_stats(
+            n_sessions=6, n_lanes=4, frames_per_session=2)
     else:
         out["quantized"] = quantized_sweep()
         out["viterbi_breakdown"] = viterbi_breakdown()
@@ -1299,6 +1546,7 @@ def main():
         out["streaming_rx"] = streaming_stats()
         out["multi_stream"] = multi_stream_stats()
         out["resilience"] = resilience_stats()
+        out["serving"] = serving_stats()
     print(json.dumps(out))
     return 0
 

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Sub-second CPU serving smoke for tools/precommit.sh (ISSUE 13).
+
+Exercises the continuous-batching server's admission / backpressure /
+deadline-shed / evict / drain state machine (runtime/serve) against a
+STUB receiver — no jax import, no compile, deterministic fake clock —
+so the gate works through TPU probe hangs exactly like chaos_smoke
+and the lint gate. The real-fleet identity/chaos matrix lives in
+tests/test_serve.py and the bench `serving` stage; this is the
+commit-time canary for the host-side protocol.
+
+Exit 0 = all checks passed; nonzero = the serving state machine is
+broken (precommit refuses the commit).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+class _StubStats:
+    def __init__(self, chunk_steps=0):
+        self.chunk_steps = chunk_steps
+
+
+class StubReceiver:
+    """Duck-typed MultiStreamReceiver for the host-side state
+    machine: S lanes, chunk_len/stride accounting, one token
+    (lane, frame) emission per consumed chunk. No device, no jax."""
+
+    def __init__(self, s, chunk_len=256, frame_len=64):
+        self.s = s
+        self.chunk_len = chunk_len
+        self.stride = chunk_len - frame_len
+        self._tails = [0] * s            # sample counts only
+        self._offsets = [0] * s
+        self._steps = 0
+        self._flushed = False
+        self.restored = {}               # lane -> blob (for asserts)
+
+    @property
+    def stats(self):
+        return _StubStats(self._steps)
+
+    def quarantined(self, i):
+        return False
+
+    def _consume(self):
+        out = []
+        while any(t >= self.chunk_len for t in self._tails):
+            self._steps += 1
+            for i in range(self.s):
+                if self._tails[i] >= self.chunk_len:
+                    out.append((i, ("frame", i, self._offsets[i])))
+                    self._tails[i] -= self.stride
+                    self._offsets[i] += self.stride
+        return out
+
+    def push_many(self, slabs):
+        for i, a in slabs.items():
+            self._tails[i] += int(a.shape[0])
+        return self._consume()
+
+    def drain_pending(self):
+        return []
+
+    def flush_stream(self, i):
+        out = []
+        if self._tails[i]:
+            self._steps += 1
+            out.append((i, ("frame", i, self._offsets[i])))
+            self._offsets[i] += self._tails[i]
+            self._tails[i] = 0
+        return out
+
+    def reset_stream(self, i):
+        self._tails[i] = 0
+        self._offsets[i] = 0
+        self.restored.pop(i, None)
+        return []
+
+    def restore_stream(self, i, blob):
+        self.reset_stream(i)
+        self.restored[i] = blob
+        self._offsets[i] = 777          # marker: restored, not fresh
+        return []
+
+    def checkpoint(self, i):
+        return (b"blob-%d" % i), []
+
+    def flush(self):
+        self._flushed = True
+        return []
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    import numpy as np
+
+    from ziria_tpu.runtime import serve
+
+    assert "jax" not in sys.modules, \
+        "serve_smoke imported jax — the smoke must stay host-only"
+
+    clock = [0.0]
+    cfg = serve.ServeConfig(
+        n_lanes=2, chunk_len=256, frame_len=64, queue_cap=2,
+        max_slab_samples=512, max_backlog_samples=1024,
+        default_slo_s=10.0, retry_after_s=0.25)
+
+    def mk():
+        return serve.ServeRuntime(
+            cfg, receiver=StubReceiver(2, 256, 64),
+            clock=lambda: clock[0])
+
+    slab = np.zeros((128, 2), np.float32)
+
+    # 1. admission: lanes fill, then the bounded queue, then explicit
+    #    reject-with-retry-after — never unbounded buffering
+    with mk() as srv:
+        rs = [srv.connect(f"c{i}") for i in range(6)]
+        assert [r.admitted for r in rs] == [True, True] + [False] * 4
+        assert [r.queued for r in rs] == [False, False, True, True,
+                                          False, False]
+        assert all(r.reason == "queue_full" and r.retry_after_s > 0
+                   for r in rs[4:])
+        # deterministic backpressure hint: scales with queue depth
+        assert rs[4].retry_after_s == cfg.retry_after_s * 3
+        assert srv.connect("c0").reason == "duplicate"
+
+        # 2. ingress bounds: oversized reject, backlog backpressure
+        r = srv.submit("c0", np.zeros((600, 2), np.float32))
+        assert not r.accepted and r.reason == "oversized"
+        for _ in range(9):
+            last = srv.submit("c0", slab)
+        assert not last.accepted and last.reason == "backlog_full" \
+            and last.retry_after_s > 0
+        try:
+            srv.submit("nobody", slab)
+            raise AssertionError("unknown session must raise")
+        except KeyError as e:
+            assert "known sessions" in str(e) and "c0" in str(e)
+        try:
+            srv.submit("c0", np.zeros((3, 5)))
+            raise AssertionError("malformed slab must raise")
+        except ValueError as e:
+            assert "c0" in str(e)
+
+        # 3. scheduling: staged samples flow, chunk-steps fire,
+        #    frames come back attributed to their session
+        got = []
+        for _ in range(8):
+            got += srv.step()
+        assert got and all(sid == "c0" for sid, _f in got)
+
+        # 4. close frees the lane and admits from the queue
+        srv.submit("c1", slab)
+        srv.close("c1")
+        st = srv.stats()
+        assert st.closed == 1 and st.active_sessions == 2
+        assert st.queue_depth == 1          # c2 promoted, c3 waits
+
+        # 5. deadline shed: deterministic via the injected clock,
+        #    counted and attributed
+        clock[0] = 11.0
+        srv.step()
+        st = srv.stats()
+        assert st.shed == 3                 # c0, c2 active; c3 queued
+        reasons = {r for _s, r, _t in st.shed_log}
+        assert reasons == {"deadline", "deadline_queued"}
+        assert {s for s, _r, _t in st.shed_log} == {"c0", "c2", "c3"}
+        r = srv.submit("c0", slab)
+        assert not r.accepted and r.reason == "shed:deadline"
+
+        # 6. evict hands back a checkpoint + staged slabs; reconnect
+        #    with the blob restores into a fresh lane
+        srv.connect("e1")
+        srv.submit("e1", slab)
+        blob, _fr, staged = srv.evict("e1")
+        assert blob == b"blob-0" and len(staged) == 1
+        r = srv.connect("e1", checkpoint=blob)
+        assert r.admitted
+        assert srv._rx.restored.get(0) == blob
+        st = srv.stats()
+        assert st.evicted == 1 and st.restored == 1
+
+        # 7. drain: stop admitting, flush, final stats intact
+        srv.connect("late-q")               # queued behind e1? no: free lane
+        final = srv.drain()
+        assert srv.connect("after").reason == "draining"
+        st = srv.stats()
+        assert st.active_sessions == 0 and st.queue_depth == 0
+        assert srv._rx._flushed
+        # exact accounting: every admitted session is terminally
+        # accounted (closed / shed / evicted / drained-closed)
+        assert st.admitted == st.closed + st.evicted + \
+            sum(1 for _s, r, _t in st.shed_log if r == "deadline")
+        assert st.shed == len(st.shed_log)
+        srv.drain()                         # idempotent
+        try:
+            srv.step()
+            raise AssertionError("step after drain must raise")
+        except RuntimeError:
+            pass
+
+        # 8. the scrape IS the stats path: Prometheus exposition
+        #    carries the serve.* series with reason labels
+        page = srv.scrape()
+        assert "# TYPE serve_admitted counter" in page
+        assert 'serve_shed{reason="deadline"}' in page
+        assert "serve_chunk_seconds_bucket" in page
+        assert "# TYPE ziria_gauge gauge" in page
+    assert "jax" not in sys.modules
+
+    dt = time.perf_counter() - t_start
+    print(f"serve smoke OK ({dt:.2f}s, no jax, "
+          f"{st.admitted} sessions accounted)")
+    assert dt < 10.0, f"serve smoke exceeded its 10s budget: {dt:.1f}s"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

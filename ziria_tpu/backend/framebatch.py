@@ -528,6 +528,30 @@ class _LaneHealth:
         return False
 
 
+def _validate_checkpoint(st, mine: dict) -> None:
+    """The ONE checkpoint-geometry gate of every restore surface
+    (``StreamReceiver(checkpoint=...)`` and the fleet's
+    ``restore_stream`` share it, so the two can never drift): refuse
+    a blob whose fingerprint is partial/absent (a raw
+    ``checkpoint_carry`` without geometry must not restore into an
+    arbitrary receiver) or disagrees with the restoring receiver."""
+    from ziria_tpu.runtime import resilience
+
+    missing = [k_ for k_ in mine if k_ not in st.geometry]
+    if missing:
+        raise resilience.CarryCheckpointError(
+            f"checkpoint lacks geometry fields {missing}; "
+            f"use StreamReceiver.checkpoint() (or pass the "
+            f"receiver geometry to checkpoint_carry) so the "
+            f"restore can be validated")
+    bad = {k_: (st.geometry[k_], mine[k_]) for k_ in mine
+           if st.geometry[k_] != mine[k_]}
+    if bad:
+        raise resilience.CarryCheckpointError(
+            f"checkpoint geometry mismatch (checkpoint, "
+            f"receiver): {bad}")
+
+
 def _stream_geometry(r) -> dict:
     """The ONE checkpoint geometry fingerprint, shared by the single-
     stream and fleet receivers (so a fleet lane's checkpoint restores
@@ -722,23 +746,7 @@ class StreamReceiver:
         self._flushed = False
         if checkpoint is not None:
             st = resilience.restore_carry(checkpoint)
-            mine = self._geometry()
-            missing = [k_ for k_ in mine if k_ not in st.geometry]
-            if missing:
-                # a blob with a partial/empty fingerprint (a raw
-                # checkpoint_carry without geometry) must not restore
-                # into an arbitrary receiver: refuse to guess
-                raise resilience.CarryCheckpointError(
-                    f"checkpoint lacks geometry fields {missing}; "
-                    f"use StreamReceiver.checkpoint() (or pass the "
-                    f"receiver geometry to checkpoint_carry) so the "
-                    f"restore can be validated")
-            bad = {k_: (st.geometry[k_], mine[k_]) for k_ in mine
-                   if st.geometry[k_] != mine[k_]}
-            if bad:
-                raise resilience.CarryCheckpointError(
-                    f"checkpoint geometry mismatch (checkpoint, "
-                    f"receiver): {bad}")
+            _validate_checkpoint(st, self._geometry())
             self._tail = np.asarray(st.tail, np.float32)
             self._offset = int(st.offset)
             self._emitted = int(st.emitted)
@@ -1291,14 +1299,27 @@ class MultiStreamReceiver:
         self._overflow_chunks = 0
         self._max_in_flight = 0
         self._max_active = 0
+        self._retired = 0      # frames credited to recycled lanes
         self._flushed = False
 
     # -- state ----------------------------------------------------------
+
+    def _check_stream(self, stream, exc=IndexError) -> int:
+        """The ONE unknown-stream-id gate of every per-lane surface:
+        at S=64 an error naming only the bad id is useless — every
+        raise here names the fleet's known id range too."""
+        if not (isinstance(stream, (int, np.integer))
+                and 0 <= int(stream) < self.s):
+            raise exc(
+                f"unknown stream id {stream!r}: this fleet's known "
+                f"ids are 0..{self.s - 1} ({self.s} streams)")
+        return int(stream)
 
     def carry(self, stream: int) -> StreamCarry:
         """Stream `stream`'s live :class:`StreamCarry` (tail, offset,
         emitted, dedupe watermark) — read-only observability, exactly
         like the single-stream receiver's."""
+        stream = self._check_stream(stream)
         return StreamCarry(self._tails[stream], self._offsets[stream],
                            self._emitted[stream],
                            self._watermarks[stream])
@@ -1310,7 +1331,8 @@ class MultiStreamReceiver:
     @property
     def stats(self) -> MultiStreamStats:
         return MultiStreamStats(
-            self.s, self._chunk_steps, sum(self._emitted),
+            self.s, self._chunk_steps,
+            sum(self._emitted) + self._retired,
             self._overflow_chunks, self._max_in_flight,
             self._max_active, self._sanitized,
             sum(h.quarantines for h in self._health),
@@ -1321,7 +1343,7 @@ class MultiStreamReceiver:
     def quarantined(self, stream: int) -> bool:
         """True while `stream` rides behind the valid-mask (poisoned
         input or repeated decode blowups; docs/robustness.md)."""
-        return self._health[stream].quarantined
+        return self._health[self._check_stream(stream)].quarantined
 
     def _geometry(self) -> dict:
         return _stream_geometry(self)
@@ -1336,8 +1358,7 @@ class MultiStreamReceiver:
         ``(state_bytes, (stream, frame) pairs)``."""
         if self._flushed:
             raise RuntimeError("checkpoint after flush")
-        if not 0 <= stream < self.s:
-            raise IndexError(f"stream {stream} not in [0, {self.s})")
+        stream = self._check_stream(stream)
         out: List = []
         if self._pending is not None:
             pend, self._pending = self._pending, None
@@ -1383,9 +1404,7 @@ class MultiStreamReceiver:
         ``sanitize=True``; docs/robustness.md)."""
         if self._flushed:
             raise RuntimeError("push after flush")
-        if not 0 <= stream < self.s:
-            raise IndexError(f"stream {stream} not in [0, {self.s})")
-        self._ingest(stream, samples)
+        self._ingest(self._check_stream(stream), samples)
         return self._pump()
 
     def push_many(self, slabs) -> List:
@@ -1398,13 +1417,8 @@ class MultiStreamReceiver:
         if self._flushed:
             raise RuntimeError("push after flush")
         if isinstance(slabs, dict):
-            for i in slabs:
-                if not (isinstance(i, (int, np.integer))
-                        and 0 <= int(i) < self.s):
-                    raise KeyError(
-                        f"push_many: unknown stream id {i!r} (this "
-                        f"fleet has streams 0..{self.s - 1})")
-            items = [(int(i), s) for i, s in slabs.items()]
+            items = [(self._check_stream(i, KeyError), s)
+                     for i, s in slabs.items()]
         else:
             if len(slabs) != self.s:
                 raise ValueError(
@@ -1431,6 +1445,108 @@ class MultiStreamReceiver:
         if self._pending is not None:
             pend, self._pending = self._pending, None
             out += self._drain(pend)
+        return out
+
+    # -- per-lane lifecycle (the serving runtime's lane recycle) --------
+    #
+    # runtime/serve.py maps client SESSIONS onto this fleet's fixed S
+    # lanes: a closing session flushes ITS lane (`flush_stream`), an
+    # evicted one checkpoints it (`checkpoint`), and the freed lane is
+    # recycled for the next admitted session (`reset_stream`) or a
+    # recovering one (`restore_stream`). None of these disturb the
+    # other lanes: per-lane state is exactly the single-stream
+    # receiver's, and the in-flight chunk-step is drained first only
+    # when the touched lane actually rides in it — an idle lane's
+    # recycle preserves the double buffer.
+
+    def drain_pending(self) -> List:
+        """Block on the in-flight chunk-step (if any) and emit it —
+        the double buffer's explicit drain point. Returns the
+        ``(stream, StreamFrame)`` pairs; safe to call any time."""
+        if self._pending is None:
+            return []
+        pend, self._pending = self._pending, None
+        return self._drain(pend)
+
+    def _pending_touches(self, stream: int) -> bool:
+        return self._pending is not None and stream in self._pending[1]
+
+    def flush_stream(self, stream: int) -> List:
+        """Close ONE stream: scan its carried tail (zero-padded, the
+        lane owning every remaining start — the per-lane twin of
+        :meth:`flush`) and drain through it, leaving every other lane
+        live. Returns the emitted ``(stream, frame)`` pairs (any lane
+        may emit — the in-flight step drains first). The lane's state
+        is NOT reset; :meth:`reset_stream` recycles it."""
+        stream = self._check_stream(stream)
+        if self._flushed:
+            raise RuntimeError("flush_stream after flush")
+        out = self.drain_pending()
+        if self._tails[stream].shape[0]:
+            out += self._step([stream], flushing=True)
+            out += self.drain_pending()
+        return out
+
+    def reset_stream(self, stream: int) -> List:
+        """Return one lane to the fresh-stream state (offset 0, empty
+        tail/dedupe, clean health) so a NEW session can ride it —
+        after :meth:`flush_stream` or an eviction's :meth:`checkpoint`.
+        Frames the lane emitted stay credited in :attr:`stats` (the
+        ``retired`` accounting). Drains the in-flight step first ONLY
+        when this lane rides in it, so recycling an idle lane never
+        costs the fleet its double-buffer overlap. Returns the drained
+        ``(stream, frame)`` pairs."""
+        stream = self._check_stream(stream)
+        out = self.drain_pending() if self._pending_touches(stream) \
+            else []
+        h = self._health[stream]
+        self._health[stream] = _LaneHealth(h.blowup_limit,
+                                           h.rejoin_after)
+        self._dirty[stream] = False
+        self._retired += self._emitted[stream]
+        self._tails[stream] = np.zeros((0, 2), np.float32)
+        self._offsets[stream] = 0
+        self._emitted[stream] = 0
+        self._watermarks[stream] = 0
+        self._seen[stream] = set()
+        return out
+
+    def restore_stream(self, stream: int, checkpoint: bytes) -> List:
+        """Restore a checkpointed session into lane ``stream`` — the
+        eviction-recovery path: a blob from ``checkpoint(i)`` (or a
+        lone ``StreamReceiver.checkpoint()``) at the same geometry
+        resumes on this lane with bit-identical subsequent emissions
+        (per-lane graphs under vmap ARE the single-stream graphs —
+        the pinned fleet contract). The quarantine rider restores
+        per-lane: a session checkpointed quarantined RESUMES
+        quarantined, its lane-mates untouched. The blob's
+        degraded/scan_degraded flags deliberately do NOT transfer —
+        they describe the OLD runtime's compiled-program health, the
+        degraded twins are bit-identical by the pinned contracts (so
+        emissions cannot diverge), and importing them would punish
+        this fleet's healthy lane-mates with the slow twin. Returns
+        the drained ``(stream, frame)`` pairs (the reset's rule)."""
+        from ziria_tpu.runtime import resilience
+
+        stream = self._check_stream(stream)
+        st = resilience.restore_carry(checkpoint)
+        _validate_checkpoint(st, self._geometry())
+        out = self.reset_stream(stream)
+        self._tails[stream] = np.asarray(st.tail, np.float32)
+        self._offsets[stream] = int(st.offset)
+        self._emitted[stream] = int(st.emitted)
+        # the restored frames were emitted elsewhere: keep this
+        # fleet's stats.frames counting ITS emissions only
+        self._retired -= int(st.emitted)
+        self._watermarks[stream] = int(st.watermark)
+        self._seen[stream] = set(st.seen)
+        rs = st.state
+        h = self._health[stream]
+        h.quarantined = bool(rs.get("quarantined", False))
+        h.clean = int(rs.get("clean", 0))
+        h.blowups = int(rs.get("blowups", 0))
+        h.quarantines = int(rs.get("quarantines", 0))
+        self._dirty[stream] = bool(rs.get("dirty", False))
         return out
 
     # -- chunk-step lifecycle -------------------------------------------

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import os
 from functools import lru_cache
-from typing import List, Optional, Sequence
+from typing import List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -460,6 +460,45 @@ def stream_many(psdus, rates_mbps: Sequence[int], gaps=None,
             starts)
 
 
+class ArrivalSpec(NamedTuple):
+    """A seeded ragged-arrival shape for `stream_many_multi`: slab
+    sizes drawn uniformly in ``[slab_lo, slab_hi)`` samples and
+    inter-arrival gaps in ``[gap_lo, gap_hi]`` scheduler ticks (gap 0
+    = the next slab lands on the same tick — a burst). One spec
+    describes the whole fleet; each stream draws its OWN schedule
+    from its folded seed, so the traffic is ragged ACROSS streams
+    too, and every replay is identical."""
+    slab_lo: int = 256
+    slab_hi: int = 2048
+    gap_lo: int = 0
+    gap_hi: int = 2
+
+
+def arrival_schedule(stream: np.ndarray, spec: ArrivalSpec,
+                     seed: int) -> List:
+    """Cut one synthesized stream into a seeded arrival schedule:
+    ``[(tick, slab), ...]`` with ticks non-decreasing and the slabs
+    concatenating back to the stream EXACTLY (the load generator
+    replays real ragged traffic, it never invents or drops samples).
+    Deterministic per (stream length, spec, seed)."""
+    if spec.slab_lo < 1 or spec.slab_hi <= spec.slab_lo:
+        raise ValueError(
+            f"arrival slab range [{spec.slab_lo}, {spec.slab_hi}) "
+            f"is empty or non-positive")
+    if spec.gap_lo < 0 or spec.gap_hi < spec.gap_lo:
+        raise ValueError(
+            f"arrival gap range [{spec.gap_lo}, {spec.gap_hi}] "
+            f"is empty or negative")
+    rng = np.random.default_rng(seed)
+    out, pos, tick, n = [], 0, 0, int(stream.shape[0])
+    while pos < n:
+        k = int(rng.integers(spec.slab_lo, spec.slab_hi))
+        out.append((tick, stream[pos: pos + k]))
+        pos += k
+        tick += int(rng.integers(spec.gap_lo, spec.gap_hi + 1))
+    return out
+
+
 def _stream_seed(seed: int, i: int) -> int:
     """Per-stream seed fold-in for `stream_many_multi`: deterministic
     and collision-free across the fleet for any base seed (the affine
@@ -476,7 +515,8 @@ def _stream_seed(seed: int, i: int) -> int:
 def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
                       cfo=0.0, delay=0, seed: int = 0,
                       add_fcs: bool = False, tail: int = 2048,
-                      gaps=None, batched_tx: Optional[bool] = None):
+                      gaps=None, batched_tx: Optional[bool] = None,
+                      arrival: Optional[ArrivalSpec] = None):
     """The S-stream load synthesizer — the stimulus of the multi-
     stream receiver (`framebatch.receive_streams`) and its bench:
     stream i is exactly ``stream_many(psdus_per_stream[i],
@@ -490,7 +530,17 @@ def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
     Returns ``(streams, starts_per_stream)``: S (n_i, 2) f32 streams
     (lengths ragged — the receiver's packer handles that) and each
     stream's TRUE frame-start indices, the ground truth the fleet
-    identity contract slices at."""
+    identity contract slices at.
+
+    ``arrival`` (an :class:`ArrivalSpec`) additionally returns a
+    third element: per-stream seeded arrival SCHEDULES —
+    ``schedules[i]`` is ``[(tick, slab), ...]`` cutting stream *i*
+    into ragged slabs with inter-arrival gaps (the serving load
+    generator's replayable traffic shape, `runtime/serve.py`); the
+    slabs concatenate back to the stream exactly, so pushing a
+    schedule through a receiver emits bit-identically to pushing the
+    whole stream. Default ``None`` keeps the two-element return —
+    existing call sites unchanged."""
     s = len(psdus_per_stream)
     if len(rates_per_stream) != s:
         raise ValueError(f"{s} streams of PSDUs but "
@@ -511,7 +561,12 @@ def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
             add_fcs=add_fcs, tail=tail, batched_tx=batched_tx)
         streams.append(st)
         starts.append(sts)
-    return streams, starts
+    if arrival is None:
+        return streams, starts
+    schedules = [arrival_schedule(streams[i], arrival,
+                                  _stream_seed(seed, i) + 1)
+                 for i in range(s)]
+    return streams, starts, schedules
 
 
 def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,

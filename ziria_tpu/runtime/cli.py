@@ -136,7 +136,9 @@ def build_parser() -> argparse.ArgumentParser:
                "docs/static_analysis.md); `python -m ziria_tpu programs "
                "[--json] [--hlo-dump DIR]` runs the compiled-program "
                "observatory (CPU-pinned XLA cost/memory attribution; "
-               "docs/observability.md)")
+               "docs/observability.md); `python -m ziria_tpu serve "
+               "[--sessions N] [--chaos SPEC]` runs the "
+               "continuous-batching serving demo (docs/serving.md)")
     p.add_argument("--prog", help="registered pipeline name")
     p.add_argument("--src", help="Ziria-like source file (.zir) to compile")
     p.add_argument("--list-progs", action="store_true")
@@ -740,6 +742,14 @@ def main(argv=None) -> int:
         # itself, so cost attribution works while the TPU probe hangs.
         from ziria_tpu.utils.programs import main as programs_main
         return programs_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # continuous-batching serving demo (runtime/serve,
+        # docs/serving.md): synthetic many-client load through the
+        # real fleet, SIGINT-safe drain + final stats/exposition,
+        # chaos-injectable. Own arg surface, dispatched BEFORE
+        # argparse like `lint`/`programs`.
+        from ziria_tpu.runtime.serve import main as serve_main
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     _apply_platform(args.platform)
     _apply_compile_cache(args.compile_cache)

@@ -32,7 +32,12 @@ poisoning the rest of the fleet. This module is that layer:
   (tail samples, offset, emitted count, dedupe watermark — plus the
   live dedupe set and a geometry fingerprint) so a crashed or
   restarted receiver resumes mid-stream with bit-identical subsequent
-  emissions (``StreamReceiver(checkpoint=...)``).
+  emissions — into a lone ``StreamReceiver(checkpoint=...)``, or
+  into a fleet lane via ``MultiStreamReceiver.restore_stream(i,
+  blob)`` (the serving runtime's eviction-recovery path,
+  docs/serving.md: ``ServeRuntime.evict`` checkpoints a session out,
+  ``connect(sid, checkpoint=blob)`` restores it into whatever lane
+  frees next).
 
 Telemetry rides throughout (free when idle): ``resilience.retries`` /
 ``resilience.recovered`` / ``resilience.fallbacks`` /
@@ -290,10 +295,11 @@ def checkpoint_carry(carry, seen=(), geometry: Optional[dict] = None,
     ``emitted`` / ``watermark`` fields — ``StreamReceiver.carry``)
     plus the dedupe set, a geometry fingerprint, and the receiver's
     runtime ``state`` dict into a compact npz-container blob.
-    ``StreamReceiver.checkpoint()`` is the receiver-level wrapper (it
-    drains the in-flight chunk first, so the blob never silently
-    drops a launched chunk's frames, and it fills ``state`` so
-    quarantine/degraded status survives the restart)."""
+    ``StreamReceiver.checkpoint()`` and
+    ``MultiStreamReceiver.checkpoint(i)`` are the receiver-level
+    wrappers (they drain the in-flight chunk first, so the blob never
+    silently drops a launched chunk's frames, and they fill ``state``
+    so quarantine/degraded status survives the restart)."""
     buf = io.BytesIO()
     np.savez(
         buf,

@@ -1,0 +1,856 @@
+"""Continuous-batching serving runtime: N client sessions multiplexed
+onto one fixed (S, K, chunk) compiled fleet geometry.
+
+The ROADMAP's last missing layer between the device-side fleet
+(`backend/framebatch.MultiStreamReceiver`, PR 11) and "heavy traffic
+from millions of users": production traffic is many clients pushing
+ragged I/Q slabs concurrently under latency SLOs, and the device side
+must never see that raggedness — Ziria's ``|>>>|`` discipline keeps
+the steady-state stream on the engine with the host touched only at
+control points, and this scheduler IS that host control point (Sora's
+dedicated-core streaming lineage: admission/eviction happen off the
+hot dispatch loop). The compiled geometry never changes:
+
+- **Admission** is a bounded queue with explicit backpressure. A
+  session gets a free lane immediately, waits in the queue, or is
+  REJECTED with a deterministic ``retry_after_s`` hint — never
+  unbounded buffering, never a silent stall.
+- **Scheduling** is continuous batching: each :meth:`ServeRuntime.step`
+  moves at most one chunk's worth of each session's staged samples
+  into its lane and fires ``push_many`` — the fleet packer dispatches
+  one chunk-step for whichever lanes filled a chunk, idle lanes ride
+  the existing valid-mask. Session count never enters the dispatch
+  budget (≤ 2 dispatches per chunk-step, the PR 11 pin).
+- **Deadlines + load shedding**: a session past its SLO deadline is
+  SHED — removed, counted, and attributed in the shed log — not
+  silently stalled. Shedding is deterministic: every decision reads
+  the injectable ``clock`` at step boundaries, so a replay sheds the
+  identical sessions at the identical steps.
+- **Fault containment** rides PR 12's machinery unchanged: NaN slabs
+  quarantine ONE lane behind the valid-mask (healthy sessions stay
+  bit-identical to independent receivers, pinned), dispatch faults
+  retry/degrade through `runtime/resilience.guarded`.
+- **Eviction + recovery**: :meth:`ServeRuntime.evict` checkpoints a
+  session's lane (`resilience.checkpoint_carry` blob, quarantine
+  rider included); ``connect(sid, checkpoint=blob)`` restores it into
+  a fresh lane with bit-identical subsequent emissions (the
+  `restore_stream` contract).
+- **Graceful drain**: :meth:`ServeRuntime.drain` stops admitting,
+  flushes every in-flight chunk and session tail, and leaves the
+  final stats — the SIGINT path of the ``python -m ziria_tpu serve``
+  demo.
+
+All SLO metrics report through the PR 7 `utils/telemetry` registry —
+:meth:`ServeRuntime.scrape` is the registry's Prometheus-style
+``exposition()``, not a parallel stats path: ``serve.*`` counters
+(admitted/queued/rejected/shed/evicted/restored/closed/frames, shed
+reasons as labels), ``serve.active_sessions`` / ``serve.queue_depth``
+gauges, and the ``serve.chunk_seconds`` latency histogram whose
+p50/p99 are the SLO numbers, next to the per-dispatch
+``ziria_dispatch_seconds{site="rx.stream_chunk_multi"}`` series the
+receiver already emits. Use the runtime as a context manager — it
+activates its registry for its lifetime and drains on exit.
+
+The module imports no jax: the receiver is injectable (the default
+builds a `MultiStreamReceiver` lazily), so `tools/serve_smoke.py`
+exercises the whole admission/shed/evict/drain state machine against
+a stub receiver in milliseconds, through TPU probe hangs.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, \
+    Tuple
+
+import numpy as np
+
+from ziria_tpu.utils import dispatch, telemetry
+
+
+class ServeConfig(NamedTuple):
+    """The server's fixed shape. The first five fields are the
+    compiled fleet geometry (`MultiStreamReceiver`'s — admission
+    churn never changes them, so the two fleet programs compile
+    once); the rest are host-side protocol bounds."""
+    n_lanes: int = 8                 # S: concurrent sessions on device
+    chunk_len: int = 1 << 13
+    frame_len: int = 2048
+    max_frames_per_chunk: int = 8
+    check_fcs: bool = False
+    queue_cap: int = 16              # admission queue bound
+    max_slab_samples: int = 1 << 16  # oversized-slab reject bound
+    max_backlog_samples: int = 1 << 18   # per-session staged bound
+    default_slo_s: Optional[float] = None  # deadline = connect + slo
+    retry_after_s: float = 0.05      # base backpressure hint
+    sanitize: bool = True            # NaN slabs quarantine, not crash
+    max_retries: Optional[int] = None    # guarded-dispatch budget
+    watchdog_s: Optional[float] = None   # hang-cut timeout
+    blowup_limit: int = 2
+    rejoin_after: int = 3
+
+
+class AdmitResult(NamedTuple):
+    """:meth:`ServeRuntime.connect`'s answer. Exactly one of
+    ``admitted``/``queued`` is True on success; both False means the
+    client should retry after ``retry_after_s`` (``reason`` says
+    why: ``queue_full`` / ``draining`` / ``duplicate``)."""
+    sid: Any
+    admitted: bool
+    queued: bool = False
+    retry_after_s: float = 0.0
+    reason: str = ""
+
+
+class SubmitResult(NamedTuple):
+    """:meth:`ServeRuntime.submit`'s answer. ``accepted=False`` with
+    a ``retry_after_s`` is backpressure (``backlog_full``); with
+    ``reason`` ``oversized`` the slab violated the protocol bound;
+    a terminal reason (``shed:deadline`` / ``evicted`` / ``closed`` /
+    ``draining``) means the session is gone — reconnect or move on.
+    Backpressure and shedding are protocol results, not exceptions:
+    only a malformed slab or an unknown session id raises."""
+    sid: Any
+    accepted: bool
+    retry_after_s: float = 0.0
+    reason: str = ""
+
+
+class ServeStats(NamedTuple):
+    """The final report (:meth:`ServeRuntime.stats`): exact session
+    accounting read back FROM the telemetry registry (the counters
+    ARE the record — ``admitted == closed + shed_active + evicted +
+    active`` by construction; a still-queued session that closes or
+    evicts lands on the separate ``serve.closed_queued`` /
+    ``serve.evicted_queued`` counters, visible in the scrape, so the
+    balance holds) plus the receiver's dispatch-side numbers."""
+    admitted: int
+    queued: int
+    rejected_admissions: int
+    rejected_slabs: int
+    shed: int
+    evicted: int
+    restored: int
+    closed: int
+    frames: int
+    chunk_steps: int
+    active_sessions: int
+    queue_depth: int
+    quarantined_sessions: int
+    shed_log: Tuple
+
+
+class _Session:
+    __slots__ = ("sid", "lane", "staged", "staged_samples", "deadline",
+                 "connected_t", "frames", "restore_blob")
+
+    def __init__(self, sid, now: float, slo_s: Optional[float],
+                 restore_blob: Optional[bytes]):
+        self.sid = sid
+        self.lane: Optional[int] = None
+        self.staged: deque = deque()      # accepted, not yet scheduled
+        self.staged_samples = 0
+        self.connected_t = now
+        self.deadline = None if slo_s is None else now + float(slo_s)
+        self.frames = 0
+        self.restore_blob = restore_blob
+
+
+def _slab(samples, sid) -> np.ndarray:
+    """The ingress shape gate (the receiver's `_slab_array` rule,
+    jax-free): coerce to (n, 2) float32 I/Q pairs or raise a
+    ValueError NAMING the session — malformed input fails at the
+    front door, never inside the scheduler."""
+    try:
+        arr = np.asarray(samples, np.float32)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"session {sid!r}: submitted slab is not "
+            f"float-convertible ((n, 2) I/Q sample pairs expected): "
+            f"{e}") from None
+    if arr.size == 0:
+        return arr.reshape(0, 2)
+    if arr.ndim != 2 or arr.shape[1] != 2:
+        raise ValueError(
+            f"session {sid!r}: submitted slab has shape {arr.shape}, "
+            f"want (n, 2) I/Q sample pairs")
+    return arr
+
+
+def _known(ids, cap: int = 16) -> str:
+    ids = sorted(ids, key=repr)
+    shown = ", ".join(repr(i) for i in ids[:cap])
+    more = f", ... {len(ids) - cap} more" if len(ids) > cap else ""
+    return f"[{shown}{more}]" if ids else "[] (none connected)"
+
+
+class ServeRuntime:
+    """The continuous-batching server. Single-threaded and
+    deterministic by design: every admission/shed/evict decision is a
+    pure function of the call sequence and the injectable ``clock``,
+    so a chaos replay reproduces the run decision for decision.
+
+    Use as a context manager::
+
+        with ServeRuntime(ServeConfig(n_lanes=8, ...)) as srv:
+            srv.connect("alice", slo_s=2.0)
+            srv.submit("alice", slab)
+            frames = srv.step()        # the scheduler tick
+            ...
+            final = srv.drain()        # or leave the block: auto-drain
+        print(srv.scrape())            # Prometheus exposition
+
+    ``receiver`` injects a duck-typed fleet (tests, the jax-free
+    smoke); the default builds a `MultiStreamReceiver` at the config
+    geometry on first use."""
+
+    def __init__(self, config: Optional[ServeConfig] = None,
+                 receiver=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[telemetry.MetricsRegistry] = None):
+        self.cfg = config if config is not None else ServeConfig()
+        if self.cfg.n_lanes < 1:
+            raise ValueError(f"n_lanes {self.cfg.n_lanes} must be >= 1")
+        self.clock = clock
+        self.registry = registry if registry is not None \
+            else telemetry.MetricsRegistry()
+        self._rx = receiver if receiver is not None \
+            else self._default_receiver()
+        self._free = list(range(self.cfg.n_lanes))
+        self._lane_sid: Dict[int, Any] = {}
+        self._sessions: Dict[Any, _Session] = {}
+        self._queue: deque = deque()
+        self._gone: Dict[Any, str] = {}   # sid -> terminal reason
+        self._spill: List = []            # (lane, frame) off-step
+        self._shed_log: List[Tuple] = []
+        self._steps_seen = 0
+        self._draining = False
+        self._drained = False
+        self._cm = None
+
+    def _default_receiver(self):
+        # lazy: jax (through framebatch) is only imported when the
+        # real fleet is wanted — the smoke's stub path never pays it
+        from ziria_tpu.backend import framebatch
+        c = self.cfg
+        return framebatch.MultiStreamReceiver(
+            c.n_lanes, chunk_len=c.chunk_len, frame_len=c.frame_len,
+            max_frames_per_chunk=c.max_frames_per_chunk,
+            check_fcs=c.check_fcs, sanitize=c.sanitize,
+            max_retries=c.max_retries, watchdog_s=c.watchdog_s,
+            blowup_limit=c.blowup_limit,
+            rejoin_after=c.rejoin_after)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def __enter__(self) -> "ServeRuntime":
+        self._cm = telemetry.collect(self.registry)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        try:
+            if not self._drained:
+                self.drain()
+        finally:
+            cm, self._cm = self._cm, None
+            cm.__exit__(*exc)
+
+    # -- telemetry helpers ----------------------------------------------
+
+    def _count(self, name: str, n: int = 1,
+               labels: Optional[dict] = None) -> None:
+        telemetry.count(name, n, labels=labels)
+
+    def _counter_total(self, name: str) -> int:
+        return sum(m.value for (n, _l), m in self.registry.metrics()
+                   if n == name
+                   and isinstance(m, telemetry.CounterMetric))
+
+    def _gauges(self) -> None:
+        dispatch.record_gauge("serve.active_sessions",
+                              len(self._lane_sid))
+        dispatch.record_gauge("serve.queue_depth", len(self._queue))
+        dispatch.record_gauge(
+            "serve.quarantined_sessions",
+            sum(1 for ln in self._lane_sid
+                if self._rx.quarantined(ln)))
+
+    def _retry_after(self) -> float:
+        # deterministic backpressure hint, scaled by the queue the
+        # rejected client would have stood behind
+        return self.cfg.retry_after_s * (1 + len(self._queue))
+
+    def scrape(self) -> str:
+        """The server's Prometheus-style scrape page — the PR 7
+        registry exposition, serve.* series next to the receiver's
+        dispatch/latency series. No parallel stats path."""
+        return self.registry.exposition()
+
+    def stats(self) -> ServeStats:
+        ct = self._counter_total
+        return ServeStats(
+            admitted=ct("serve.admitted"),
+            queued=ct("serve.queued"),
+            rejected_admissions=ct("serve.rejected_admissions"),
+            rejected_slabs=ct("serve.rejected_slabs"),
+            shed=ct("serve.shed"),
+            evicted=ct("serve.evicted"),
+            restored=ct("serve.restored"),
+            closed=ct("serve.closed"),
+            frames=ct("serve.frames"),
+            chunk_steps=int(self._rx.stats.chunk_steps),
+            active_sessions=len(self._lane_sid),
+            queue_depth=len(self._queue),
+            quarantined_sessions=sum(
+                1 for ln in self._lane_sid
+                if self._rx.quarantined(ln)),
+            shed_log=tuple(self._shed_log))
+
+    # -- admission -------------------------------------------------------
+
+    def connect(self, sid, slo_s: Optional[float] = None,
+                checkpoint: Optional[bytes] = None) -> AdmitResult:
+        """Admit a session: a free lane immediately, the bounded
+        queue, or an explicit reject with a retry hint — never
+        unbounded buffering. ``slo_s`` sets the deadline (connect
+        time + slo; the config default applies when None);
+        ``checkpoint`` restores an evicted session's blob into the
+        granted lane (`restore_stream` — bit-identical resumption,
+        quarantine rider included)."""
+        if self._draining or self._drained:
+            self._count("serve.rejected_admissions",
+                        labels={"reason": "draining"})
+            return AdmitResult(sid, False, False,
+                               self._retry_after(), "draining")
+        if sid in self._sessions:
+            return AdmitResult(sid, False, False, 0.0, "duplicate")
+        now = self.clock()
+        slo = slo_s if slo_s is not None else self.cfg.default_slo_s
+        s = _Session(sid, now, slo, checkpoint)
+        if self._free:
+            self._gone.pop(sid, None)  # reconnect after shed/evict
+            self._sessions[sid] = s
+            self._admit(s)
+            self._gauges()
+            return AdmitResult(sid, True)
+        if len(self._queue) >= self.cfg.queue_cap:
+            # a REJECTED reconnect keeps its terminal _gone record:
+            # submits keep answering with the old reason, not a raise
+            self._count("serve.rejected_admissions",
+                        labels={"reason": "queue_full"})
+            return AdmitResult(sid, False, False,
+                               self._retry_after(), "queue_full")
+        self._gone.pop(sid, None)      # reconnect after shed/evict
+        self._sessions[sid] = s
+        self._queue.append(sid)
+        self._count("serve.queued")
+        self._gauges()
+        return AdmitResult(sid, False, True, 0.0, "queued")
+
+    def _admit(self, s: _Session) -> None:
+        lane = self._free.pop(0)
+        s.lane = lane
+        self._lane_sid[lane] = s.sid
+        if s.restore_blob is not None:
+            self._spill += self._rx.restore_stream(lane,
+                                                   s.restore_blob)
+            s.restore_blob = None
+            self._count("serve.restored")
+        self._count("serve.admitted")
+
+    def _admit_waiting(self) -> None:
+        while self._free and self._queue:
+            sid = self._queue.popleft()
+            self._admit(self._sessions[sid])
+
+    # -- ingress ---------------------------------------------------------
+
+    def is_active(self, sid) -> bool:
+        """True while ``sid`` holds a lane (admitted, not yet
+        closed/shed/evicted) — the client-visible promotion signal:
+        a queued session becomes active when a lane frees. Closing a
+        session before it is active discards its staged data (it was
+        never served), so well-behaved clients close active sessions
+        only."""
+        s = self._sessions.get(sid)
+        return s is not None and s.lane is not None
+
+    def _get_session(self, sid) -> _Session:
+        s = self._sessions.get(sid)
+        if s is None:
+            raise KeyError(
+                f"unknown session {sid!r}: known sessions are "
+                f"{_known(self._sessions)}")
+        return s
+
+    def submit(self, sid, samples) -> SubmitResult:
+        """Stage one slab of samples for ``sid``. Bounded end to end:
+        an oversized slab is rejected (``max_slab_samples``), a slab
+        that would overflow the session's staging bound is rejected
+        with a retry hint (``max_backlog_samples`` — the per-session
+        backpressure that contains floods). A slab for a shed/
+        evicted/closed session returns its terminal reason; a truly
+        unknown session raises a KeyError naming the known ones."""
+        s = self._sessions.get(sid)
+        if s is None:
+            reason = self._gone.get(sid)
+            if reason is not None:
+                return SubmitResult(sid, False, 0.0, reason)
+            self._get_session(sid)     # raises the named KeyError
+        arr = _slab(samples, sid)
+        n = int(arr.shape[0])
+        if n > self.cfg.max_slab_samples:
+            self._count("serve.rejected_slabs",
+                        labels={"reason": "oversized"})
+            return SubmitResult(sid, False, 0.0, "oversized")
+        if s.staged_samples + n > self.cfg.max_backlog_samples:
+            self._count("serve.rejected_slabs",
+                        labels={"reason": "backlog_full"})
+            return SubmitResult(sid, False, self._retry_after(),
+                                "backlog_full")
+        if n:
+            s.staged.append(arr)
+            s.staged_samples += n
+        return SubmitResult(sid, True)
+
+    # -- the scheduler tick ---------------------------------------------
+
+    def _take_staged(self, s: _Session,
+                     budget: int) -> Optional[np.ndarray]:
+        """Pop exactly up to one chunk's worth of staged samples —
+        the continuous-batching rate limit: a flooding client
+        advances at MOST one chunk per tick (a slab crossing the
+        budget is split, its tail pushed back), its excess held
+        (bounded) in staging. Push-boundary invariance (the
+        ragged-push pin) makes the re-slabbing bit-invisible to the
+        receiver."""
+        if not s.staged:
+            return None
+        take, got = [], 0
+        while s.staged and got < budget:
+            a = s.staged.popleft()
+            need = budget - got
+            if a.shape[0] > need:
+                s.staged.appendleft(a[need:])
+                a = a[:need]
+            take.append(a)
+            got += a.shape[0]
+        s.staged_samples -= got
+        return take[0] if len(take) == 1 else np.concatenate(take)
+
+    def _emit(self, pairs) -> List[Tuple[Any, Any]]:
+        """Map receiver (lane, frame) emissions back to sessions."""
+        out = []
+        for lane, fr in pairs:
+            sid = self._lane_sid.get(lane)
+            if sid is None:            # pragma: no cover - drained
+                continue               # lanes are emptied before free
+            self._sessions[sid].frames += 1
+            out.append((sid, fr))
+        if out:
+            self._count("serve.frames", len(out))
+        return out
+
+    def _take_spill(self) -> List[Tuple[Any, Any]]:
+        if not self._spill:
+            return []
+        spill, self._spill = self._spill, []
+        return self._emit(spill)
+
+    def _note_steps(self, dt: float) -> None:
+        d = int(self._rx.stats.chunk_steps) - self._steps_seen
+        if d <= 0:
+            return
+        self._steps_seen += d
+        per = dt / d
+        for _ in range(d):
+            telemetry.observe("serve.chunk_seconds", per)
+
+    def _push(self, push: Dict[int, np.ndarray]) -> List:
+        t0 = time.perf_counter()
+        got = self._rx.push_many(push)
+        self._note_steps(time.perf_counter() - t0)
+        return self._emit(got)
+
+    def step(self) -> List[Tuple[Any, Any]]:
+        """One scheduler tick: shed expired sessions, admit from the
+        queue into freed lanes, move up to one chunk's worth of each
+        session's staged samples into its lane, and fire the fleet
+        packer (one ``push_many`` — chunk-steps dispatch for
+        whichever lanes filled, idle lanes ride the valid-mask).
+        Returns the ``(sid, StreamFrame)`` pairs that became
+        decodable this tick."""
+        if self._drained:
+            raise RuntimeError("step after drain")
+        out = self._take_spill()
+        out += self._shed_expired()
+        self._admit_waiting()
+        push = {}
+        for lane, sid in self._lane_sid.items():
+            take = self._take_staged(self._sessions[sid],
+                                     self.cfg.chunk_len)
+            if take is not None:
+                push[lane] = take
+        if push:
+            out += self._push(push)
+        self._gauges()
+        return out
+
+    # -- deadlines / shedding -------------------------------------------
+
+    def _shed_expired(self) -> List[Tuple[Any, Any]]:
+        """SLO-aware load shedding, deterministic and attributable:
+        every session past its deadline — queued or active — is
+        removed NOW, counted under its reason label, and logged
+        ``(sid, reason, t)``. Never a silent stall."""
+        now = self.clock()
+        out: List[Tuple[Any, Any]] = []
+        for sid in [q for q in self._queue
+                    if self._expired(q, now)]:
+            self._queue.remove(sid)
+            del self._sessions[sid]
+            self._shed(sid, "deadline_queued", now)
+        for lane in [ln for ln, sid in self._lane_sid.items()
+                     if self._expired(sid, now)]:
+            sid = self._lane_sid[lane]
+            out += self._release(sid, shed_reason="deadline", t=now)
+        return out
+
+    def _expired(self, sid, now: float) -> bool:
+        d = self._sessions[sid].deadline
+        return d is not None and now > d
+
+    def _shed(self, sid, reason: str, t: float) -> None:
+        self._gone[sid] = f"shed:{reason}"
+        self._shed_log.append((sid, reason, t))
+        self._count("serve.shed", labels={"reason": reason})
+
+    def _release(self, sid, shed_reason: Optional[str] = None,
+                 t: Optional[float] = None,
+                 counted: Optional[str] = None) -> List:
+        """Free a session's lane: drain anything it still rides in
+        the in-flight step (attributed before the mapping goes away),
+        reset the lane for recycling, and unmap."""
+        s = self._sessions[sid]
+        lane = s.lane
+        out = self._emit(self._rx.reset_stream(lane))
+        del self._lane_sid[lane]
+        bisect.insort(self._free, lane)
+        del self._sessions[sid]
+        if shed_reason is not None:
+            self._shed(sid, shed_reason, t)
+        elif counted is not None:
+            self._gone[sid] = counted
+            self._count(f"serve.{counted}")
+        return out
+
+    # -- close / evict / drain ------------------------------------------
+
+    def close(self, sid) -> List[Tuple[Any, Any]]:
+        """Graceful per-session end: push everything the session
+        still has staged, flush its lane (the final zero-padded
+        chunk), free the lane, and admit the next queued session.
+        Returns the emissions (any session may ride along — the
+        in-flight step drains)."""
+        s = self._get_session(sid)
+        if s.lane is None:
+            # closing a still-QUEUED session: it was never admitted,
+            # so it gets its own counter — serve.closed stays in the
+            # admitted == closed + evicted + shed_active balance
+            self._queue.remove(sid)
+            del self._sessions[sid]
+            self._gone[sid] = "closed"
+            self._count("serve.closed_queued")
+            return []
+        out = []
+        while True:
+            take = self._take_staged(s, self.cfg.chunk_len)
+            if take is None:
+                break
+            out += self._push({s.lane: take})
+        t0 = time.perf_counter()
+        got = self._rx.flush_stream(s.lane)
+        self._note_steps(time.perf_counter() - t0)
+        out += self._emit(got)
+        out += self._release(sid, counted="closed")
+        self._admit_waiting()
+        self._gauges()
+        return out
+
+    def evict(self, sid) -> Tuple[Optional[bytes], List, List]:
+        """Evict a session, preserving it: checkpoint its lane (the
+        in-flight step drains; quarantine rider travels in the blob),
+        free the lane, and return ``(blob, emissions,
+        staged_slabs)`` — the staged-but-unscheduled slabs hand back
+        so the recovering client resubmits them after
+        ``connect(sid, checkpoint=blob)``. Evicting a still-QUEUED
+        session returns ``(None, [], staged)`` (no lane state
+        exists yet)."""
+        s = self._get_session(sid)
+        staged = list(s.staged)
+        s.staged.clear()
+        s.staged_samples = 0
+        if s.lane is None:
+            # evicting a still-QUEUED session: never admitted, no
+            # lane state — own counter, same balance rule as close
+            self._queue.remove(sid)
+            del self._sessions[sid]
+            self._gone[sid] = "evicted"
+            self._count("serve.evicted_queued")
+            return None, [], staged
+        blob, got = self._rx.checkpoint(s.lane)
+        out = self._emit(got)
+        out += self._release(sid, counted="evicted")
+        self._admit_waiting()
+        self._gauges()
+        return blob, out, staged
+
+    def drain(self) -> List[Tuple[Any, Any]]:
+        """Graceful shutdown: stop admitting (queued sessions are
+        shed with reason ``draining`` — they never held device
+        state), flush every active session's staged samples and lane,
+        drain the in-flight chunk, and close the fleet. Idempotent;
+        the final :meth:`stats`/:meth:`scrape` survive it."""
+        if self._drained:
+            return []
+        self._draining = True
+        out = self._take_spill()
+        now = self.clock()
+        while self._queue:
+            sid = self._queue.popleft()
+            del self._sessions[sid]
+            self._shed(sid, "draining", now)
+        for sid in [self._lane_sid[ln]
+                    for ln in sorted(self._lane_sid)]:
+            out += self.close(sid)
+        got = self._rx.flush()
+        # the fleet is closed: anything still pending drained above
+        out += self._emit(got)
+        self._drained = True
+        self._gauges()
+        return out
+
+
+# ---------------------------------------------------------- load generator
+
+
+class ClientSpec(NamedTuple):
+    """One synthetic client of the load generator: an id, a seeded
+    arrival schedule (``[(tick, slab), ...]``), the ground-truth
+    stream it was cut from, an optional SLO, and a misbehavior mode
+    (``"ok"`` / ``"nan"`` poisoned slab / ``"flood"`` everything at
+    tick 0 / ``"stall"`` delivers only the first half then goes
+    silent / ``"oversize"`` one protocol-violating giant slab)."""
+    sid: Any
+    schedule: List
+    stream: np.ndarray
+    slo_s: Optional[float] = None
+    mode: str = "ok"
+
+
+def synth_load(n_sessions: int, frames_per_session: int = 3,
+               n_bytes: int = 12, snr_db: float = 30.0,
+               seed: int = 0, add_fcs: bool = True,
+               tail: int = 1024, arrival=None,
+               misbehave: Optional[Dict[int, str]] = None,
+               slo_s: Optional[float] = None) -> List[ClientSpec]:
+    """The many-client load generator (built on
+    `link.stream_many_multi`'s arrival schedules): ``n_sessions``
+    independent mixed-rate streams cut into seeded ragged slab
+    schedules, with ``misbehave`` marking sessions by int index —
+    ``{3: "nan"}``-style modes rewrite that session's schedule into
+    the corresponding bad-client behavior. Fully deterministic per
+    seed. Imports jax (through the PHY) — the jax-free smoke uses its
+    own stub traffic instead."""
+    from ziria_tpu.phy import link
+    from ziria_tpu.phy.wifi.params import RATES
+
+    if arrival is None:
+        arrival = link.ArrivalSpec()
+    misbehave = dict(misbehave or {})
+    rng = np.random.default_rng(seed)
+    rates_all = sorted(RATES)
+    psdus_per, rates_per = [], []
+    for i in range(n_sessions):
+        rates = [rates_all[(i + j) % len(rates_all)]
+                 for j in range(frames_per_session)]
+        rates_per.append(rates)
+        psdus_per.append([rng.integers(0, 256, n_bytes)
+                          .astype(np.uint8) for _ in rates])
+    streams, _starts, schedules = link.stream_many_multi(
+        psdus_per, rates_per, snr_db=snr_db, cfo=1e-4, delay=60,
+        seed=seed, add_fcs=add_fcs, tail=tail, arrival=arrival)
+
+    out = []
+    for i in range(n_sessions):
+        mode = misbehave.get(i, "ok")
+        sched = schedules[i]
+        if mode == "flood":
+            # everything at once, one giant burst of max-size slabs
+            whole = streams[i]
+            sched = [(0, whole[a: a + (1 << 14)])
+                     for a in range(0, whole.shape[0], 1 << 14)]
+        elif mode == "stall":
+            sched = sched[: max(1, len(sched) // 2)]
+        elif mode == "nan":
+            # poison a deterministic slab mid-schedule
+            j = len(sched) // 2
+            t, bad = sched[j]
+            bad = np.array(bad, copy=True)
+            bad[:: 7] = np.nan
+            sched = sched[:j] + [(t, bad)] + sched[j + 1:]
+        elif mode == "oversize":
+            t0 = sched[0][0] if sched else 0
+            sched = [(t0, np.zeros((1 << 20, 2), np.float32))] + sched
+        elif mode != "ok":
+            raise ValueError(f"unknown misbehave mode {mode!r}")
+        out.append(ClientSpec(f"s{i}", sched, streams[i], slo_s,
+                              mode))
+    return out
+
+
+def run_clients(srv: ServeRuntime, clients: List[ClientSpec],
+                max_ticks: int = 10000) -> Dict[Any, List]:
+    """Drive a client set against a server, tick by tick: connect
+    everyone up front (rejected clients retry each tick — the
+    backpressure protocol), deliver each schedule's due slabs
+    (resubmitting on backpressure), step the scheduler, close
+    clients whose schedule is done (stalled clients never close —
+    the deadline shed or the drain collects them), then drain.
+    Returns ``{sid: [StreamFrame, ...]}`` per session. Deterministic
+    for a deterministic server clock."""
+    frames: Dict[Any, List] = {c.sid: [] for c in clients}
+
+    def collect(pairs):
+        for sid, fr in pairs:
+            frames[sid].append(fr)
+
+    todo = {c.sid: deque(c.schedule) for c in clients}
+    pending = {c.sid: c for c in clients}       # not yet connected
+    unclosed = {c.sid: c for c in clients}
+    tick = 0
+    while tick <= max_ticks:
+        for sid in list(pending):
+            r = srv.connect(sid, slo_s=pending[sid].slo_s)
+            if r.admitted or r.queued:
+                del pending[sid]
+        for c in clients:
+            if c.sid in pending:
+                continue
+            q = todo[c.sid]
+            while q and q[0][0] <= tick:
+                t, slab = q[0]
+                r = srv.submit(c.sid, slab)
+                if r.accepted or not r.retry_after_s:
+                    q.popleft()     # accepted, or terminally refused
+                else:
+                    break           # backpressure: retry next tick
+        collect(srv.step())
+        for done in [s for s, c in unclosed.items()
+                     if c.mode != "stall" and not todo[s]
+                     and s not in pending]:
+            if srv.is_active(done):
+                collect(srv.close(done))
+                del unclosed[done]
+            elif done in srv._gone:
+                del unclosed[done]   # shed/evicted — accounted there
+            # else: still queued — close once a lane frees it in
+        tick += 1
+        if not unclosed and not any(todo.values()):
+            break
+        if all(c.mode == "stall" for c in unclosed.values()) \
+                and not any(todo[s] for s in unclosed) \
+                and not pending:
+            break
+    collect(srv.drain())
+    return frames
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def main(argv=None) -> int:
+    """``python -m ziria_tpu serve`` — the serving demo: a synthetic
+    many-client load (misbehaving clients included) through the real
+    fleet, SIGINT-safe (a ^C drains gracefully and still prints the
+    final stats + exposition), chaos-injectable via ``--chaos``."""
+    import argparse
+    import json
+    import sys
+
+    from ziria_tpu.utils import faults
+
+    p = argparse.ArgumentParser(
+        prog="ziria_tpu serve",
+        description="continuous-batching serving demo "
+                    "(docs/serving.md)")
+    p.add_argument("--lanes", type=int, default=4,
+                   help="device lanes S (compiled fleet width)")
+    p.add_argument("--sessions", type=int, default=6,
+                   help="client sessions to serve")
+    p.add_argument("--frames", type=int, default=2,
+                   help="frames per session")
+    p.add_argument("--chunk-len", type=int, default=4096)
+    p.add_argument("--frame-len", type=int, default=1024)
+    p.add_argument("--slo", type=float, default=None,
+                   help="per-session deadline seconds (default none)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--nan-client", action="store_true",
+                   help="make session 0 push a NaN-poisoned slab "
+                        "(quarantine demo)")
+    p.add_argument("--chaos", metavar="SPEC", default=None,
+                   help="fault-injection spec (utils/faults grammar)")
+    p.add_argument("--metrics-dump", action="store_true",
+                   help="print the Prometheus exposition to stderr "
+                        "at exit")
+    args = p.parse_args(argv)
+
+    cfg = ServeConfig(n_lanes=args.lanes, chunk_len=args.chunk_len,
+                      frame_len=args.frame_len, check_fcs=True,
+                      default_slo_s=args.slo)
+    misbehave = {0: "nan"} if args.nan_client else {}
+    clients = synth_load(args.sessions, args.frames, seed=args.seed,
+                         misbehave=misbehave, tail=args.frame_len)
+    chaos = None
+    if args.chaos is not None:
+        try:
+            chaos = faults.parse_chaos_spec(args.chaos)
+        except ValueError as e:
+            raise SystemExit(f"--chaos: {e}")
+
+    srv = ServeRuntime(cfg)
+    frames: Dict[Any, List] = {}
+    import contextlib
+    try:
+        with contextlib.ExitStack() as stack:
+            if chaos is not None:
+                specs, seed = chaos
+                stack.enter_context(faults.inject(*specs, seed=seed))
+            stack.enter_context(srv)
+            try:
+                frames = run_clients(srv, clients)
+            except KeyboardInterrupt:
+                # SIGINT-safe drain: stop admitting, flush in-flight
+                # chunks, fall through to the final stats
+                srv.drain()
+                frames = {}
+    finally:
+        st = srv.stats()
+        lat = srv.registry.find("serve.chunk_seconds")
+        report = {
+            "sessions": args.sessions, "lanes": args.lanes,
+            "frames": sum(len(v) for v in frames.values()),
+            "stats": {k: (list(v) if isinstance(v, tuple) else v)
+                      for k, v in st._asdict().items()},
+            "chunk_latency_ms": lat.summary(scale=1e3)
+            if lat is not None else {"count": 0},
+        }
+        print(json.dumps(report))
+        if args.metrics_dump:
+            print("metrics exposition (utils/telemetry):",
+                  file=sys.stderr)
+            print(srv.scrape(), file=sys.stderr, end="")
+    return 0

@@ -51,6 +51,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -430,6 +431,12 @@ def span(name: str, args: Optional[dict] = None):
     if not traces:
         yield
         return
+    if not _listener_installed:
+        # activation may have preceded the jax import (the CLI shell
+        # activates before _run_cmd imports jax): retry here, BEFORE
+        # the traced call — dispatch.timed enters this span ahead of
+        # the jit call, so even the first compile is captured
+        _install_compile_listener()
     ann = None
     if any(t.annotate_device for t in traces):
         cls = _annotation_cls()
@@ -523,6 +530,8 @@ def dispatch_event(label: str, n: int = 1,
                    seconds: Optional[float] = None) -> None:
     """One instrumented dispatch site firing: counter always,
     histogram observation when the site is timed."""
+    if _REGISTRIES and not _listener_installed:
+        _install_compile_listener()   # activation preceded jax import
     for r in _REGISTRIES:
         r.counter(DISPATCH_COUNTER, site=label).inc(n)
         if seconds is not None:
@@ -542,26 +551,33 @@ def gauge_sample(label: str, value: float) -> None:
         tr.counter(label, value)
 
 
-def observe(name: str, value: float) -> None:
+def observe(name: str, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
     """One free-standing histogram observation (the resilience
-    layer's backoff delays): lands in every active registry's
-    ``name`` histogram. Free when nothing is collecting."""
+    layer's backoff delays, the serving runtime's per-chunk-step
+    latency): lands in every active registry's ``name`` histogram
+    (label-partitioned when ``labels`` is given). Free when nothing
+    is collecting."""
     if not _REGISTRIES:
         return
     for r in _REGISTRIES:
-        r.histogram(name).observe(value)
+        r.histogram(name, **(labels or {})).observe(value)
 
 
 def count(name: str, n: int = 1,
-          total: Optional[float] = None) -> None:
+          total: Optional[float] = None,
+          labels: Optional[Dict[str, str]] = None) -> None:
     """An event counter (frames emitted, sessions admitted):
     increments every active registry; when the caller passes its
     cumulative ``total``, active traces get a counter-track sample so
-    the count is plottable over the run."""
+    the count is plottable over the run. ``labels`` partitions the
+    counter per label set (the serving runtime's attributable
+    ``serve.shed{reason=...}`` discipline) — the exposition carries
+    each label series separately."""
     if not (_TRACES or _REGISTRIES):
         return
     for r in _REGISTRIES:
-        r.counter(name).inc(n)
+        r.counter(name, **(labels or {})).inc(n)
     if total is not None:
         for tr in _TRACES:
             tr.counter(name, total)
@@ -610,10 +626,17 @@ def _on_jax_duration(event: str, duration: float, **kw) -> None:
 
 def _install_compile_listener() -> None:
     """Register the jax.monitoring duration listener once, lazily, on
-    first activation — importing jax (or running without it) before
-    any telemetry is used costs nothing."""
+    the first activation AFTER jax is in play — importing jax (or
+    running without it) before any telemetry is used costs nothing,
+    and a deliberately jax-free process (the serving smoke, the trace
+    tooling) activating telemetry must never drag jax in: when jax is
+    absent the install is deferred, and the next activation — or the
+    first span/dispatch emission after a jax import (the CLI shell
+    activates before its command imports jax) — picks it up."""
     global _listener_installed
     if _listener_installed:
+        return
+    if "jax" not in sys.modules:
         return
     _listener_installed = True
     try:
