@@ -100,6 +100,7 @@ STAGE_METRICS = {
     "multi_stream": ("sps_multi", "higher"),
     "resilience": ("faults_recovered", "higher"),
     "serving": ("sps_serving", "higher"),
+    "soak": ("recovery_p99_s", "lower"),
     "lint": ("findings_total", "lower"),
     "programs": ("programs_analyzed", "higher"),
     "numpy_baseline": ("sps", "higher"),
@@ -1606,6 +1607,56 @@ def _child_main(run_id):
             note(f"serving stage failed: {e!r}")
             serving_ev = {"error": repr(e)}
 
+    # ISSUE 14 tentpole evidence: the chaos-SOAK of the DURABLE
+    # serving runtime (tools/soak.py) — seeded fault campaign over
+    # every fault kind (dispatch + push + the new io_torn/io_enospc
+    # durability seams) plus a real subprocess SIGKILL mid-chunk-step,
+    # each round crash -> ServeRuntime.recover(), gating zero crashes,
+    # per-session bit-identity vs the uninterrupted oracle, the
+    # <= 2-dispatches-per-chunk-step budget under no_recompile after
+    # recovery, and the recovery-latency SLO; recovery_p99_s (lower is
+    # better) lands in the trajectory. Same resumable never-fatal
+    # stage discipline.
+    def _load_soak():
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "soak", os.path.join(REPO, "tools", "soak.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _soak_stage():
+        if time.time() - t0 > 0.95 * budget:
+            raise TimeoutError("skipped: child time budget")
+        cpu = os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+        ev = _load_soak().soak_stats(
+            n_sessions=3 if cpu else 6,
+            n_lanes=4 if cpu else 8,
+            frames_per_session=3 if cpu else 4,
+            rounds=2 if cpu else 4,
+            sigkill_rounds=1 if cpu else 2)
+        note(f"soak: {ev['faults_injected']} fault(s) "
+             f"({ev['faults_by_kind']}) over {ev['rounds']} crash "
+             f"round(s) + {ev['sigkill_rounds']} SIGKILL round(s) "
+             f"(killed={ev['kills']['killed']}), recovery p50/p99 "
+             f"{ev['recovery_p50_s']}/{ev['recovery_p99_s']} s, "
+             f"{ev['dispatches_per_chunk_step_post_recovery']} "
+             f"dispatches/chunk-step after recovery, "
+             f"{ev['duplicates']} at-least-once duplicate(s) "
+             f"deduped by (sid, start), bit-identical, zero crashes")
+        part("soak", **ev)
+        return ev
+
+    if "soak" in resume:
+        soak_ev = reuse(resume["soak"])
+        note("soak resumed from prior window")
+    else:
+        try:
+            soak_ev = _soak_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"soak stage failed: {e!r}")
+            soak_ev = {"error": repr(e)}
+
     # ISSUE 8 tentpole evidence: the jaxlint static-analysis sweep —
     # per-rule finding counts (and the suppression count) over
     # ziria_tpu/, recorded in the artifact so the trend — and any
@@ -1752,6 +1803,7 @@ def _child_main(run_id):
         "multi_stream": multi_ev,
         "resilience": res_ev,
         "serving": serving_ev,
+        "soak": soak_ev,
         "lint": lint_ev,
         "programs": prog_ev,
         "roofline": _roofline(

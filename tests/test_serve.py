@@ -134,9 +134,13 @@ def test_admission_queue_and_backpressure():
         assert [r.queued for r in rs] == [False, False, True, True,
                                           False, False]
         # the reject is explicit, reasoned, and carries a
-        # deterministic retry hint scaled by the queue depth
+        # deterministic retry hint scaled by the queue depth — with
+        # per-session hashed jitter (ISSUE 14: synchronized rejects
+        # must not re-arrive in lockstep); the envelope is
+        # [0.5, 1.0) x base x (1 + depth)
         assert rs[4].reason == "queue_full"
-        assert rs[4].retry_after_s == 0.5 * 3
+        assert 0.5 * (0.5 * 3) <= rs[4].retry_after_s < 0.5 * 3
+        assert rs[4].retry_after_s != rs[5].retry_after_s
         assert srv.connect("c0").reason == "duplicate"
         st = srv.stats()
         assert (st.admitted, st.queued, st.rejected_admissions) \

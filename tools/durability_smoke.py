@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Sub-second CPU durability smoke for tools/precommit.sh (ISSUE 14).
+
+Exercises the crash-durability layer (runtime/durability,
+runtime/resilience checkpoint integrity, runtime/serve recovery)
+against a STUB receiver — journal write/replay/torn-tail resync,
+atomic snapshot write/load/prune, io_torn/io_enospc injection,
+checkpoint CRC + legacy-blob compatibility, and a full
+crash -> ``ServeRuntime.recover`` session-table reconstruction — with
+no jax import, so the gate works through TPU probe hangs exactly like
+chaos_smoke and serve_smoke. The real-fleet bit-identity matrix lives
+in tests/test_durability.py and the bench `soak` stage; this is the
+commit-time canary for the durable-serving protocol.
+
+Exit 0 = all checks passed; nonzero = the durability layer is broken
+(precommit refuses the commit).
+"""
+
+import io
+import os
+import shutil
+import sys
+import tempfile
+import time
+from types import SimpleNamespace
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+class _StubStats:
+    def __init__(self, chunk_steps):
+        self.chunk_steps = chunk_steps
+
+
+class StubReceiver:
+    """Sample-count stub whose checkpoints are REAL
+    ``ziria-stream-carry-v1`` blobs, so the serve recovery path
+    exercises the genuine parse / acked / dedupe math."""
+
+    GEO = {"chunk_len": 256, "frame_len": 64}
+
+    def __init__(self, s, chunk_len=256, frame_len=64):
+        import numpy as np
+        self._np = np
+        self.s, self.chunk_len = s, chunk_len
+        self.stride = chunk_len - frame_len
+        self._tails = [0] * s
+        self._offsets = [0] * s
+        self._emitted = [0] * s
+        self._steps = 0
+        self._flushed = False
+        self.restored = {}
+
+    @property
+    def stats(self):
+        return _StubStats(self._steps)
+
+    def quarantined(self, i):
+        return False
+
+    def push_many(self, slabs):
+        out = []
+        for i, a in slabs.items():
+            self._tails[i] += int(a.shape[0])
+        while any(t >= self.chunk_len for t in self._tails):
+            self._steps += 1
+            for i in range(self.s):
+                if self._tails[i] >= self.chunk_len:
+                    out.append((i, ("frame", i, self._offsets[i])))
+                    self._emitted[i] += 1
+                    self._tails[i] -= self.stride
+                    self._offsets[i] += self.stride
+        return out
+
+    def drain_pending(self):
+        return []
+
+    def flush_stream(self, i):
+        out = []
+        if self._tails[i]:
+            self._steps += 1
+            out.append((i, ("frame", i, self._offsets[i])))
+            self._emitted[i] += 1
+            self._tails[i] = 0
+        return out
+
+    def reset_stream(self, i):
+        self._tails[i] = 0
+        self._offsets[i] = 0
+        self._emitted[i] = 0
+        self.restored.pop(i, None)
+        return []
+
+    def restore_stream(self, i, blob):
+        from ziria_tpu.runtime import resilience
+        st = resilience.restore_carry(blob)
+        self.restored[i] = blob
+        self._offsets[i] = int(st.offset)
+        self._tails[i] = int(st.tail.shape[0])
+        self._emitted[i] = int(st.emitted)
+        return []
+
+    def _blob(self, i):
+        from ziria_tpu.runtime import resilience
+        carry = SimpleNamespace(
+            tail=self._np.zeros((self._tails[i], 2), self._np.float32),
+            offset=self._offsets[i], emitted=self._emitted[i],
+            watermark=self._offsets[i])
+        return resilience.checkpoint_carry(carry, geometry=self.GEO)
+
+    def checkpoint(self, i):
+        return self._blob(i), []
+
+    def checkpoint_fleet(self, lanes=None):
+        which = range(self.s) if lanes is None else lanes
+        return {i: self._blob(i) for i in which}, []
+
+    def flush(self):
+        self._flushed = True
+        return []
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    import numpy as np
+
+    from ziria_tpu.runtime import durability, resilience, serve
+    from ziria_tpu.utils import faults
+
+    assert "jax" not in sys.modules, \
+        "durability_smoke imported jax — the smoke must stay host-only"
+
+    root = tempfile.mkdtemp(prefix="ziria-durability-smoke-")
+    try:
+        # 1. journal roundtrip + rotation + reopen-seals-the-open
+        jd = os.path.join(root, "j1")
+        j = durability.Journal(jd, segment_records=3)
+        for i in range(7):
+            j.append({"ev": "t", "i": i})
+        recs, st = durability.replay(jd)
+        assert [r["i"] for r in recs] == list(range(7))
+        assert [r["q"] for r in recs] == list(range(1, 8))
+        assert st.dropped == 0
+        names = sorted(os.listdir(jd))
+        assert names == ["wal-000000000001.log",
+                         "wal-000000000004.log",
+                         "wal-000000000007.open"], names
+        # a second writer (the recovered process) seals the leftover
+        # .open and resumes the sequence counter past everything
+        j2 = durability.Journal(jd, segment_records=3)
+        assert j2.seq == 7
+        assert not [n for n in os.listdir(jd) if n.endswith(".open")]
+        j2.append({"ev": "t", "i": 7})
+        recs, _ = durability.replay(jd)
+        assert [r["i"] for r in recs] == list(range(8))
+        # prune: sealed segments fully covered by a snapshot vanish
+        j2.prune(6)
+        left = sorted(os.listdir(jd))
+        assert "wal-000000000001.log" not in left
+        recs, _ = durability.replay(jd, after_seq=6)
+        assert [r["i"] for r in recs] == [6, 7]
+
+        # 2. torn tail: a truncated last record drops cleanly, and a
+        #    torn MID-segment record never corrupts its neighbours
+        jd = os.path.join(root, "j2")
+        j = durability.Journal(jd, segment_records=100)
+        j.append({"ev": "keep", "k": 1})
+        with faults.inject(faults.FaultSpec(
+                "journal.append", "io_torn", calls=(0,), fraction=0.5)):
+            j.append({"ev": "torn"})
+        j.append({"ev": "keep", "k": 2})
+        j._f.write(b"ZWAL\x40\x00\x00\x00\xde\xad\xbe\xefpartial")
+        j._f.flush()
+        recs, st = durability.replay(jd)
+        assert [r.get("k") for r in recs] == [1, 2], recs
+        assert st.dropped >= 2        # torn record + torn tail
+
+        # 3. io_enospc surfaces as OSError (serve contains + counts)
+        with faults.inject(faults.FaultSpec(
+                "journal.append", "io_enospc", every=1)):
+            try:
+                j.append({"ev": "x"})
+                raise AssertionError("ENOSPC must raise")
+            except OSError as e:
+                assert "No space left" in str(e)
+
+        # 4. snapshot atomicity: tmp dirs are invisible, corrupt
+        #    snapshots fall back to the previous one, prune keeps 2
+        sd = os.path.join(root, "snaps")
+        for step in (1, 2, 3):
+            durability.write_snapshot(
+                sd, step, {0: b"blob-%d" % step},
+                {"jseq": step}, keep=2)
+        snaps = sorted(n for n in os.listdir(sd)
+                       if n.startswith("snap-"))
+        assert snaps == ["snap-0000000002", "snap-0000000003"]
+        os.makedirs(os.path.join(sd, ".tmp-snap-0000000009.123"))
+        got = durability.load_snapshot(sd)
+        assert got.step == 3 and got.lanes[0] == b"blob-3"
+        # corrupt the newest meta: loader falls back to snap-2
+        mp = os.path.join(sd, "snap-0000000003", "meta.json")
+        with open(mp, "r+b") as f:
+            f.seek(10)
+            f.write(b"XX")
+        got = durability.load_snapshot(sd)
+        assert got.step == 2 and got.lanes[0] == b"blob-2"
+
+        # 5. checkpoint CRC integrity + legacy compatibility
+        carry = SimpleNamespace(
+            tail=np.arange(8, dtype=np.float32).reshape(4, 2),
+            offset=512, emitted=3, watermark=448)
+        blob = resilience.checkpoint_carry(
+            carry, seen=(500,), geometry={"chunk_len": 256})
+        st5 = resilience.restore_carry(blob)
+        assert st5.offset == 512 and st5.emitted == 3
+        # flip one payload byte INSIDE the npz: CRC must catch it
+        bad = bytearray(blob)
+        # find the tail array bytes and corrupt one
+        idx = bad.find(np.float32(5.0).tobytes())
+        assert idx > 0
+        bad[idx] ^= 0xFF
+        try:
+            resilience.restore_carry(bytes(bad))
+            raise AssertionError("corrupt blob must not restore")
+        except resilience.CarryCheckpointError as e:
+            assert "integrity" in str(e) or "unreadable" in str(e)
+        # legacy blob (no crc field): loads, counted
+        import numpy.lib.format  # noqa: F401  (np.load path)
+        z = dict(np.load(io.BytesIO(blob), allow_pickle=False))
+        z.pop("crc")
+        buf = io.BytesIO()
+        np.savez(buf, **z)
+        from ziria_tpu.utils import telemetry
+        reg = telemetry.MetricsRegistry()
+        with telemetry.collect(reg):
+            st5 = resilience.restore_carry(buf.getvalue())
+        assert st5.offset == 512
+        page = reg.exposition()
+        assert "resilience_checkpoint_legacy" in page
+
+        # 6. atomic checkpoint file write: tmp+fsync+rename
+        cp = os.path.join(root, "lane.ckpt")
+        resilience.save_checkpoint(cp, blob)
+        assert resilience.load_checkpoint(cp).offset == 512
+        assert not [n for n in os.listdir(root)
+                    if n.startswith(".lane.ckpt.tmp")]
+
+        # 7. crash -> recover: the session table reconstructs EXACTLY
+        #    (lanes restored, queued repacked, terminal reasons kept,
+        #    dedupe watermarks at the last durable mark)
+        dd = os.path.join(root, "srv")
+        clock = [0.0]
+        cfg = serve.ServeConfig(
+            n_lanes=2, chunk_len=256, frame_len=64, queue_cap=4,
+            default_slo_s=50.0, snapshot_dir=dd, snapshot_every=1)
+        slab = np.zeros((300, 2), np.float32)
+        srv = serve.ServeRuntime(cfg, receiver=StubReceiver(2),
+                                 clock=lambda: clock[0])
+        with srv:
+            srv.connect("a")
+            srv.connect("b")
+            srv.connect("q1")              # queued
+            srv.submit("a", slab)
+            srv.submit("b", slab)
+            srv.step()
+            srv.submit("a", slab)
+            srv.step()
+            srv.close("b")                 # frees a lane; q1 promotes
+            srv._drained = True            # CRASH: no drain
+        assert srv.stats().snapshots >= 1
+        srv2 = serve.ServeRuntime.recover(
+            dd, receiver=StubReceiver(2), clock=lambda: clock[0])
+        assert set(srv2._sessions) == {"a", "q1"}
+        assert srv2._gone.get("b") == "closed"
+        assert srv2.recovered["a"]["acked"] > 0
+        assert srv2.recovered["a"]["dedupe_until"] >= 1
+        assert srv2._rx.restored, "lane blob must restore"
+        assert srv2.stats().restarts == 1
+        r = srv2.submit("b", slab)
+        assert not r.accepted and r.reason == "closed"
+        # ELASTIC repack: recover the same state onto ONE lane — the
+        # second session waits in the queue instead of refusing
+        srv3 = serve.ServeRuntime.recover(
+            dd, config=cfg._replace(n_lanes=1),
+            receiver=StubReceiver(1), clock=lambda: clock[0])
+        assert set(srv3._sessions) == {"a", "q1"}
+        assert sum(1 for s in ("a", "q1")
+                   if srv3.is_active(s)) == 1
+        assert len(srv3._queue) == 1
+
+        # 8. jittered retry-after: deterministic per (sid, attempt),
+        #    spread across sids — no reject lockstep
+        cfg8 = serve.ServeConfig(n_lanes=1, chunk_len=256,
+                                 frame_len=64, queue_cap=0,
+                                 retry_after_s=1.0)
+
+        def hints():
+            s8 = serve.ServeRuntime(cfg8, receiver=StubReceiver(1),
+                                    clock=lambda: 0.0)
+            with s8:
+                s8.connect("holder")
+                return [s8.connect(f"r{i}").retry_after_s
+                        for i in range(6)]
+
+        h1, h2 = hints(), hints()
+        assert h1 == h2                      # replay-deterministic
+        assert len(set(h1)) == 6             # spread, not lockstep
+        assert all(0.5 <= h < 1.0 for h in h1), h1
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    assert "jax" not in sys.modules
+    dt = time.perf_counter() - t_start
+    print(f"durability smoke OK ({dt:.2f}s, no jax)")
+    assert dt < 10.0, f"durability smoke exceeded 10s: {dt:.1f}s"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,17 @@ if ! timeout 30 python tools/serve_smoke.py; then
   exit 1
 fi
 
+# durability smoke (ISSUE 14): the crash-safe journal / snapshot /
+# recovery machinery exercised against a stub receiver — sub-second,
+# never imports jax (works through TPU probe hangs, like its
+# siblings). A broken durability layer must not reach a commit.
+if ! timeout 30 python tools/durability_smoke.py; then
+  echo "[precommit] durability smoke FAILED" \
+       "(tools/durability_smoke.py) — commit refused" >&2
+  echo "[precommit] (ZIRIA_SKIP_TESTGATE=1 to override for WIP)" >&2
+  exit 1
+fi
+
 # perf-ledger regression gate (ISSUE 9): latest vs previous
 # same-platform run in BENCH_TRAJECTORY.jsonl. Lenient tolerance —
 # bench numbers on a shared box are noisy; the gate exists to catch

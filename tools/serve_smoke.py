@@ -127,8 +127,12 @@ def main() -> int:
                                           False, False]
         assert all(r.reason == "queue_full" and r.retry_after_s > 0
                    for r in rs[4:])
-        # deterministic backpressure hint: scales with queue depth
-        assert rs[4].retry_after_s == cfg.retry_after_s * 3
+        # deterministic JITTERED backpressure hint (ISSUE 14): scales
+        # with queue depth, spread by the per-session hash so
+        # synchronized rejects never re-arrive in lockstep
+        base = cfg.retry_after_s * 3
+        assert 0.5 * base <= rs[4].retry_after_s < base
+        assert rs[4].retry_after_s != rs[5].retry_after_s
         assert srv.connect("c0").reason == "duplicate"
 
         # 2. ingress bounds: oversized reject, backlog backpressure

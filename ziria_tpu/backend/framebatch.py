@@ -1348,6 +1348,23 @@ class MultiStreamReceiver:
     def _geometry(self) -> dict:
         return _stream_geometry(self)
 
+    def _lane_state(self, stream: int) -> dict:
+        """The checkpoint runtime-state rider of one lane (quarantine
+        health + fleet degraded flags), shared by the per-lane and
+        whole-fleet checkpoint surfaces so the two can never drift."""
+        h = self._health[stream]
+        return {"quarantined": h.quarantined, "clean": h.clean,
+                "blowups": h.blowups, "quarantines": h.quarantines,
+                "dirty": self._dirty[stream],
+                "degraded": self._degraded,
+                "scan_degraded": self._scan_degraded}
+
+    def _lane_blob(self, stream: int) -> bytes:
+        from ziria_tpu.runtime import resilience
+        return resilience.checkpoint_carry(
+            self.carry(stream), seen=self._seen[stream],
+            geometry=self._geometry(), state=self._lane_state(stream))
+
     def checkpoint(self, stream: int):
         """Serialize one fleet lane's live stream state (the in-flight
         chunk-step is drained first; its fleet-wide emissions return
@@ -1359,20 +1376,29 @@ class MultiStreamReceiver:
         if self._flushed:
             raise RuntimeError("checkpoint after flush")
         stream = self._check_stream(stream)
-        out: List = []
-        if self._pending is not None:
-            pend, self._pending = self._pending, None
-            out = self._drain(pend)
-        from ziria_tpu.runtime import resilience
-        h = self._health[stream]
-        state = {"quarantined": h.quarantined, "clean": h.clean,
-                 "blowups": h.blowups, "quarantines": h.quarantines,
-                 "dirty": self._dirty[stream],
-                 "degraded": self._degraded,
-                 "scan_degraded": self._scan_degraded}
-        return resilience.checkpoint_carry(
-            self.carry(stream), seen=self._seen[stream],
-            geometry=self._geometry(), state=state), out
+        out = self.drain_pending()
+        return self._lane_blob(stream), out
+
+    def checkpoint_fleet(self, lanes=None):
+        """Serialize the fleet's live stream state in one pass — the
+        serving runtime's automatic-snapshot surface (ISSUE 14): the
+        in-flight chunk-step is drained ONCE (its emissions returned
+        alongside — they belong to the pre-snapshot past and must
+        reach the caller, never be silently dropped), then the lane
+        blobs are taken against the now-quiescent state. ``lanes``
+        restricts serialization to a subset (the server passes its
+        OCCUPIED lanes — idle lanes' blobs would be built only to be
+        discarded); None means all S. Returns ``({stream:
+        state_bytes}, (stream, frame) pairs)``; each blob is exactly
+        what :meth:`checkpoint` would produce, so any lane restores
+        into a lone receiver or another fleet's :meth:`restore_stream`
+        at the same geometry."""
+        if self._flushed:
+            raise RuntimeError("checkpoint after flush")
+        out = self.drain_pending()
+        which = range(self.s) if lanes is None \
+            else [self._check_stream(i) for i in lanes]
+        return {i: self._lane_blob(i) for i in which}, out
 
     # -- the push surface -----------------------------------------------
 

@@ -30,6 +30,35 @@ def frame_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def largest_divisor(n: int, cap: int) -> int:
+    """The largest divisor of ``n`` that is <= ``cap`` — the mesh
+    width an S-lane fleet can actually use (the stream axis must
+    shard EVENLY, `shard_batch`'s rule). >= 1 always (every fleet
+    runs on one device)."""
+    if n < 1 or cap < 1:
+        raise ValueError(f"need n >= 1 and cap >= 1, got ({n}, {cap})")
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def elastic_mesh(n_streams: int, n_devices: Optional[int] = None,
+                 axis: str = "dp") -> Optional[Mesh]:
+    """The ELASTIC placement rule (ISSUE 14 failover): build the
+    widest dp mesh the surviving device fleet supports for an
+    ``n_streams``-lane receiver — the largest divisor of S that fits
+    the visible (or capped) device count. Returns None when that is
+    one device (an unsharded receiver is the correct degenerate
+    mesh), so recovery onto a shrunken ``--devices`` — or a machine
+    that lost a chip — rebuilds the fleet on whatever is left instead
+    of refusing to start."""
+    avail = len(jax.devices()) if n_devices is None \
+        else min(n_devices, len(jax.devices()))
+    m = largest_divisor(n_streams, max(1, avail))
+    return None if m <= 1 else frame_mesh(m, axis)
+
+
 def lane_sharding(mesh: Mesh, ndim: int, axis: str = "dp") -> NamedSharding:
     """The ONE placement rule of every dp surface: leading (frame/lane)
     axis sharded over `axis`, everything else replicated."""

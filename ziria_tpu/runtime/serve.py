@@ -39,6 +39,14 @@ hot dispatch loop). The compiled geometry never changes:
   flushes every in-flight chunk and session tail, and leaves the
   final stats — the SIGINT path of the ``python -m ziria_tpu serve``
   demo.
+- **Crash durability** (ISSUE 14, docs/robustness.md): with
+  ``snapshot_dir`` set, every state transition journals
+  (runtime/durability write-ahead log) and the fleet snapshots
+  atomically every ``snapshot_every`` chunk-steps —
+  :meth:`ServeRuntime.recover` rebuilds the whole fleet after a
+  ``kill -9`` with bit-identical emissions (at-least-once, deduped
+  against the journaled delivery watermarks), elastically repacking
+  onto fewer lanes when devices shrank.
 
 All SLO metrics report through the PR 7 `utils/telemetry` registry —
 :meth:`ServeRuntime.scrape` is the registry's Prometheus-style
@@ -59,7 +67,9 @@ a stub receiver in milliseconds, through TPU probe hangs.
 
 from __future__ import annotations
 
+import base64
 import bisect
+import os
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, \
@@ -67,7 +77,8 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, \
 
 import numpy as np
 
-from ziria_tpu.utils import dispatch, telemetry
+from ziria_tpu.runtime import durability, resilience
+from ziria_tpu.utils import dispatch, faults, telemetry
 
 
 class ServeConfig(NamedTuple):
@@ -90,6 +101,15 @@ class ServeConfig(NamedTuple):
     watchdog_s: Optional[float] = None   # hang-cut timeout
     blowup_limit: int = 2
     rejoin_after: int = 3
+    # durability (ISSUE 14): a snapshot_dir activates the write-ahead
+    # journal; snapshot_every > 0 adds automatic fleet snapshots every
+    # N chunk-steps (ServeRuntime.recover(dir) resumes after a crash)
+    snapshot_dir: Optional[str] = None
+    snapshot_every: int = 0
+    snapshot_keep: int = 2
+    journal_segment_records: int = 256
+    jitter_seed: int = 0             # retry-after hint jitter seed
+    shard: bool = False              # elastic dp mesh over the lanes
 
 
 class AdmitResult(NamedTuple):
@@ -140,11 +160,16 @@ class ServeStats(NamedTuple):
     queue_depth: int
     quarantined_sessions: int
     shed_log: Tuple
+    snapshots: int = 0
+    restarts: int = 0
+    deduped: int = 0
+    journal_errors: int = 0
 
 
 class _Session:
     __slots__ = ("sid", "lane", "staged", "staged_samples", "deadline",
-                 "connected_t", "frames", "restore_blob")
+                 "connected_t", "frames", "restore_blob", "slo_s",
+                 "dedupe_until", "acked", "unacked")
 
     def __init__(self, sid, now: float, slo_s: Optional[float],
                  restore_blob: Optional[bytes]):
@@ -153,9 +178,19 @@ class _Session:
         self.staged: deque = deque()      # accepted, not yet scheduled
         self.staged_samples = 0
         self.connected_t = now
+        self.slo_s = None if slo_s is None else float(slo_s)
         self.deadline = None if slo_s is None else now + float(slo_s)
-        self.frames = 0
+        self.frames = 0                   # per-session emission index
         self.restore_blob = restore_blob
+        # durability bookkeeping (ISSUE 14): re-emissions with index
+        # <= dedupe_until were already delivered before a crash and
+        # are suppressed on recovery; `acked` is the stream coordinate
+        # durably consumed (the client resubmits from it); `unacked`
+        # holds (index, frame) pairs emitted but not yet journal-
+        # marked — they ride the next snapshot as the rider
+        self.dedupe_until = 0
+        self.acked = 0
+        self.unacked: List[Tuple[int, Any]] = []
 
 
 def _slab(samples, sid) -> np.ndarray:
@@ -229,19 +264,47 @@ class ServeRuntime:
         self._draining = False
         self._drained = False
         self._cm = None
+        self._rejects: Dict[Any, int] = {}   # sid -> reject attempts
+        # durability (ISSUE 14): the write-ahead journal + snapshot
+        # cadence; recovery state lives on `recovered`/`replayed`
+        self._journal: Optional[durability.Journal] = None
+        if self.cfg.snapshot_dir:
+            self._journal = durability.Journal(
+                os.path.join(self.cfg.snapshot_dir, "journal"),
+                segment_records=self.cfg.journal_segment_records)
+        self._marked: Dict[Any, int] = {}      # sid -> journaled mark
+        self._pending_marks: Dict[Any, int] = {}
+        # snapshot steps are ABSOLUTE across restarts: a recovered
+        # runtime's receiver restarts chunk_steps at 0, so recover()
+        # sets _step_base to the recovered snapshot's step — without
+        # it, post-recovery snapshots would be numbered BELOW the
+        # pre-crash ones and pruned as "oldest" (second-crash rollback)
+        self._step_base = 0
+        self._last_snap_step = 0
+        self._last_snap_t: Optional[float] = None
+        self.recovered: Dict[Any, dict] = {}   # recovery info per sid
+        self.replayed: List[Tuple[Any, Any]] = []  # rider re-delivery
 
     def _default_receiver(self):
         # lazy: jax (through framebatch) is only imported when the
         # real fleet is wanted — the smoke's stub path never pays it
         from ziria_tpu.backend import framebatch
         c = self.cfg
+        mesh = None
+        if c.shard:
+            # the ELASTIC placement rule: shard the lane axis over
+            # the widest S-divisible mesh the surviving devices
+            # support — a recovery onto fewer chips rebuilds the
+            # fleet instead of refusing to start (ISSUE 14)
+            from ziria_tpu.parallel import batch as pbatch
+            mesh = pbatch.elastic_mesh(c.n_lanes)
         return framebatch.MultiStreamReceiver(
             c.n_lanes, chunk_len=c.chunk_len, frame_len=c.frame_len,
             max_frames_per_chunk=c.max_frames_per_chunk,
             check_fcs=c.check_fcs, sanitize=c.sanitize,
             max_retries=c.max_retries, watchdog_s=c.watchdog_s,
             blowup_limit=c.blowup_limit,
-            rejoin_after=c.rejoin_after)
+            rejoin_after=c.rejoin_after, mesh=mesh)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -278,10 +341,65 @@ class ServeRuntime:
             sum(1 for ln in self._lane_sid
                 if self._rx.quarantined(ln)))
 
-    def _retry_after(self) -> float:
-        # deterministic backpressure hint, scaled by the queue the
-        # rejected client would have stood behind
-        return self.cfg.retry_after_s * (1 + len(self._queue))
+    def _retry_after(self, sid=None) -> float:
+        """Deterministic backpressure hint, scaled by the queue the
+        rejected client would have stood behind — with PER-SESSION
+        HASHED JITTER (ISSUE 14 satellite): an unjittered hint is the
+        same for every client at the same depth, so a flood of
+        synchronized rejects re-arrives in lockstep and floods again.
+        The jitter is the resilience backoff discipline — a unit hash
+        of (label, seed, attempt), never drawn — so a replay hints
+        identically: hint = base * (1 + depth) * (0.5 + 0.5 * u)."""
+        base = self.cfg.retry_after_s * (1 + len(self._queue))
+        attempt = self._rejects.get(sid, 0)
+        self._rejects[sid] = attempt + 1
+        # bound the attempt table: a flood of unique-sid rejects is
+        # exactly the overload this hint exists for, and must not
+        # leak memory — an evicted entry just restarts that client's
+        # jitter sequence (harmless)
+        while len(self._rejects) > 4096:
+            self._rejects.pop(next(iter(self._rejects)))
+        u = faults._unit(f"{sid!r}", self.cfg.jitter_seed, attempt)
+        return base * (0.5 + 0.5 * u)
+
+    # -- durability: the write-ahead journal --------------------------
+
+    def _j(self, ev: dict) -> None:
+        """Best-effort durable journal append: a failed write (a full
+        disk, an injected ``io_enospc``) is counted and contained —
+        the fleet keeps serving; the lost record only WIDENS the
+        recovery dedupe window (at-least-once, never a crash)."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(ev)
+        except OSError:
+            self._count("serve.journal_errors")
+
+    def _flush_marks(self) -> None:
+        """Journal the delivery watermarks of everything returned by
+        the PREVIOUS public call. Marks are deferred one call on
+        purpose: a mark written before the caller actually received
+        the frames would, after a crash in between, dedupe away
+        frames nobody ever got (silent loss). Deferred, the crash
+        window yields a re-delivery instead (at-least-once; the
+        (sid, frame.start) pair is the idempotency key)."""
+        if not self._pending_marks:
+            return
+        marks, self._pending_marks = self._pending_marks, {}
+        self._j({"ev": "mark",
+                 "d": {str(sid): n for sid, n in marks.items()}})
+        for sid, n in marks.items():
+            self._marked[sid] = n
+            s = self._sessions.get(sid)
+            if s is not None:
+                while s.unacked and s.unacked[0][0] <= n:
+                    s.unacked.pop(0)
+
+    @staticmethod
+    def _b64(blob: Optional[bytes]) -> Optional[str]:
+        return None if blob is None \
+            else base64.b64encode(blob).decode()
 
     def scrape(self) -> str:
         """The server's Prometheus-style scrape page — the PR 7
@@ -307,7 +425,11 @@ class ServeRuntime:
             quarantined_sessions=sum(
                 1 for ln in self._lane_sid
                 if self._rx.quarantined(ln)),
-            shed_log=tuple(self._shed_log))
+            shed_log=tuple(self._shed_log),
+            snapshots=ct("serve.snapshots"),
+            restarts=ct("serve.restarts"),
+            deduped=ct("serve.deduped"),
+            journal_errors=ct("serve.journal_errors"))
 
     # -- admission -------------------------------------------------------
 
@@ -320,11 +442,12 @@ class ServeRuntime:
         ``checkpoint`` restores an evicted session's blob into the
         granted lane (`restore_stream` — bit-identical resumption,
         quarantine rider included)."""
+        self._flush_marks()
         if self._draining or self._drained:
             self._count("serve.rejected_admissions",
                         labels={"reason": "draining"})
             return AdmitResult(sid, False, False,
-                               self._retry_after(), "draining")
+                               self._retry_after(sid), "draining")
         if sid in self._sessions:
             return AdmitResult(sid, False, False, 0.0, "duplicate")
         now = self.clock()
@@ -334,6 +457,9 @@ class ServeRuntime:
             self._gone.pop(sid, None)  # reconnect after shed/evict
             self._sessions[sid] = s
             self._admit(s)
+            self._j({"ev": "admit", "sid": sid, "slo": slo,
+                     "ckpt": self._b64(checkpoint)})
+            self._rejects.pop(sid, None)
             self._gauges()
             return AdmitResult(sid, True)
         if len(self._queue) >= self.cfg.queue_cap:
@@ -342,11 +468,14 @@ class ServeRuntime:
             self._count("serve.rejected_admissions",
                         labels={"reason": "queue_full"})
             return AdmitResult(sid, False, False,
-                               self._retry_after(), "queue_full")
+                               self._retry_after(sid), "queue_full")
         self._gone.pop(sid, None)      # reconnect after shed/evict
         self._sessions[sid] = s
         self._queue.append(sid)
         self._count("serve.queued")
+        self._j({"ev": "admit", "sid": sid, "slo": slo,
+                 "ckpt": self._b64(checkpoint)})
+        self._rejects.pop(sid, None)
         self._gauges()
         return AdmitResult(sid, False, True, 0.0, "queued")
 
@@ -355,10 +484,21 @@ class ServeRuntime:
         s.lane = lane
         self._lane_sid[lane] = s.sid
         if s.restore_blob is not None:
-            self._spill += self._rx.restore_stream(lane,
-                                                   s.restore_blob)
+            blob = s.restore_blob
+            self._spill += self._rx.restore_stream(lane, blob)
             s.restore_blob = None
+            try:
+                st = resilience.restore_carry(blob)
+                # the session's emission index resumes at the lane's
+                # (the 1:1 emit rule), and `acked` names the stream
+                # coordinate the blob durably consumed — the client
+                # resubmits from there
+                s.frames = int(st.emitted)
+                s.acked = int(st.offset) + int(st.tail.shape[0])
+            except resilience.CarryCheckpointError:
+                pass    # duck-typed stub blob: counters stay fresh
             self._count("serve.restored")
+        self._marked.setdefault(s.sid, s.frames)
         self._count("serve.admitted")
 
     def _admit_waiting(self) -> None:
@@ -394,6 +534,7 @@ class ServeRuntime:
         backpressure that contains floods). A slab for a shed/
         evicted/closed session returns its terminal reason; a truly
         unknown session raises a KeyError naming the known ones."""
+        self._flush_marks()
         s = self._sessions.get(sid)
         if s is None:
             reason = self._gone.get(sid)
@@ -409,7 +550,7 @@ class ServeRuntime:
         if s.staged_samples + n > self.cfg.max_backlog_samples:
             self._count("serve.rejected_slabs",
                         labels={"reason": "backlog_full"})
-            return SubmitResult(sid, False, self._retry_after(),
+            return SubmitResult(sid, False, self._retry_after(sid),
                                 "backlog_full")
         if n:
             s.staged.append(arr)
@@ -442,13 +583,25 @@ class ServeRuntime:
         return take[0] if len(take) == 1 else np.concatenate(take)
 
     def _emit(self, pairs) -> List[Tuple[Any, Any]]:
-        """Map receiver (lane, frame) emissions back to sessions."""
+        """Map receiver (lane, frame) emissions back to sessions.
+        Re-emissions already delivered before a crash (index at or
+        below the session's journaled dedupe watermark) are SUPPRESSED
+        and counted — the recovery dedupe window, docs/robustness.md.
+        Delivered frames ride ``unacked`` until their mark is durably
+        journaled (the next public call), so a snapshot in between
+        can carry them as the rider."""
         out = []
         for lane, fr in pairs:
             sid = self._lane_sid.get(lane)
             if sid is None:            # pragma: no cover - drained
                 continue               # lanes are emptied before free
-            self._sessions[sid].frames += 1
+            s = self._sessions[sid]
+            s.frames += 1
+            if s.frames <= s.dedupe_until:
+                self._count("serve.deduped")
+                continue
+            s.unacked.append((s.frames, fr))
+            self._pending_marks[sid] = s.frames
             out.append((sid, fr))
         if out:
             self._count("serve.frames", len(out))
@@ -485,6 +638,7 @@ class ServeRuntime:
         decodable this tick."""
         if self._drained:
             raise RuntimeError("step after drain")
+        self._flush_marks()
         out = self._take_spill()
         out += self._shed_expired()
         self._admit_waiting()
@@ -496,8 +650,261 @@ class ServeRuntime:
                 push[lane] = take
         if push:
             out += self._push(push)
+        out += self._maybe_snapshot()
         self._gauges()
         return out
+
+    # -- durability: snapshots + recovery -------------------------------
+
+    def _maybe_snapshot(self) -> List[Tuple[Any, Any]]:
+        """The automatic cadence: every ``snapshot_every`` chunk-steps
+        the whole fleet snapshots (ISSUE 14 tentpole). Between
+        snapshots the age gauges keep the staleness visible."""
+        if self._journal is None or self.cfg.snapshot_every <= 0:
+            return []
+        steps = self._step_base + int(self._rx.stats.chunk_steps)
+        if steps - self._last_snap_step < self.cfg.snapshot_every:
+            if self._last_snap_t is not None:
+                dispatch.record_gauge("serve.snapshot_age_s",
+                                      self.clock()
+                                      - self._last_snap_t)
+                dispatch.record_gauge("serve.snapshot_age_steps",
+                                      steps - self._last_snap_step)
+            return []
+        return self.snapshot()
+
+    def snapshot(self) -> List[Tuple[Any, Any]]:
+        """Write one atomic fleet snapshot: drain the in-flight
+        chunk-step (its emissions are returned — they belong to the
+        caller, never to the snapshot alone), then persist every
+        occupied lane's checkpoint blob, the session table (SLO
+        remainders, delivery watermarks, queued sessions' restore
+        blobs), the terminal-reason map, the undelivered-frame rider,
+        and the journal watermark — one atomic directory rename
+        (runtime/durability.py). A failed write (full disk, injected
+        ``io_enospc``) is contained: counted, the previous snapshot
+        stays authoritative, serving continues."""
+        if self._journal is None:
+            raise RuntimeError(
+                "snapshot without a snapshot_dir (set "
+                "ServeConfig.snapshot_dir)")
+        lanes, got = self._rx.checkpoint_fleet(
+            sorted(self._lane_sid))
+        out = self._emit(got)
+        now = self.clock()
+        step = self._step_base + int(self._rx.stats.chunk_steps)
+        sessions = []
+        for sid in ([self._lane_sid[ln]
+                     for ln in sorted(self._lane_sid)]
+                    + list(self._queue)):
+            s = self._sessions[sid]
+            sessions.append({
+                "sid": sid, "lane": s.lane, "slo": s.slo_s,
+                "slo_rem": None if s.deadline is None
+                else max(0.0, s.deadline - now),
+                "delivered": self._marked.get(sid, 0),
+                "ckpt": self._b64(s.restore_blob)})
+        rider, skipped = [], 0
+        for sid, s in self._sessions.items():
+            for idx, fr in s.unacked:
+                try:
+                    rider.append({"sid": sid, "idx": idx,
+                                  "frame": durability.encode_frame(
+                                      fr)})
+                except Exception:    # noqa: BLE001 - duck-typed stub
+                    skipped += 1
+        if skipped:
+            self._count("serve.rider_skipped", skipped)
+        body = {"config": dict(self.cfg._asdict()),
+                "jseq": int(self._journal.seq),
+                "sessions": sessions,
+                "gone": [[sid, r] for sid, r in self._gone.items()],
+                "rider": rider}
+        try:
+            durability.write_snapshot(
+                self.cfg.snapshot_dir, step, lanes, body,
+                keep=self.cfg.snapshot_keep)
+        except OSError:
+            self._count("serve.snapshot_errors")
+            return out
+        self._journal.prune(body["jseq"])
+        self._last_snap_step = step
+        self._last_snap_t = now
+        self._count("serve.snapshots")
+        dispatch.record_gauge("serve.snapshot_age_s", 0.0)
+        dispatch.record_gauge("serve.snapshot_age_steps", 0)
+        return out
+
+    def acked(self, sid) -> int:
+        """The stream coordinate durably consumed for ``sid`` — after
+        :meth:`recover`, the client resubmits its stream from here
+        (everything before it is inside the restored lane state;
+        everything after was lost with the process and must be pushed
+        again)."""
+        return self._get_session(sid).acked
+
+    @classmethod
+    def recover(cls, snapshot_dir: str,
+                config: Optional[ServeConfig] = None,
+                receiver=None,
+                clock: Callable[[], float] = time.monotonic,
+                registry: Optional[telemetry.MetricsRegistry] = None
+                ) -> "ServeRuntime":
+        """Rebuild a crashed server from its durability directory —
+        the ISSUE 14 acceptance path: load the newest VALID snapshot,
+        replay journal records past its watermark to reconstruct the
+        session table exactly (admissions after the snapshot restore
+        as fresh sessions; shed/evicted/closed sessions stay gone
+        with their terminal reasons; delivery watermarks advance to
+        the last durable mark), restore every lane blob into the new
+        fleet, and re-deliver the snapshot's undelivered-frame rider
+        (``.replayed``) — at-least-once, deduped against the
+        journaled watermarks.
+
+        ``config`` overrides the snapshot's recorded config — the
+        ELASTIC failover lever: recover with a smaller ``n_lanes``
+        (devices shrank) and sessions beyond the surviving lanes are
+        repacked into the admission queue, restoring as lanes free
+        (zero recompiles beyond the new geometry's two programs).
+        ``.recovered`` maps every live session to its ``acked``
+        resubmission coordinate and dedupe watermark."""
+        snap = durability.load_snapshot(snapshot_dir)
+        base_seq = int(snap.body.get("jseq", 0)) if snap else 0
+        events, rstats = durability.replay(
+            os.path.join(snapshot_dir, "journal"),
+            after_seq=base_seq)
+        if config is None:
+            if snap is None:
+                raise ValueError(
+                    f"{snapshot_dir}: no usable snapshot — journal-"
+                    f"only recovery needs an explicit config")
+            config = ServeConfig(**snap.body["config"])
+        config = config._replace(snapshot_dir=snapshot_dir)
+
+        # reduce snapshot + journal into the final session table
+        live: Dict[Any, dict] = {}
+        delivered: Dict[Any, int] = {}
+        order: List[Any] = []
+        by_str: Dict[str, Any] = {}
+        gone: Dict[Any, str] = {}
+
+        def note(sid):
+            by_str[str(sid)] = sid
+            if sid not in order:
+                order.append(sid)
+
+        if snap is not None:
+            for ent in snap.body.get("sessions", []):
+                sid = ent["sid"]
+                blob = None
+                if ent.get("lane") is not None:
+                    blob = snap.lanes.get(int(ent["lane"]))
+                elif ent.get("ckpt"):
+                    blob = base64.b64decode(ent["ckpt"])
+                live[sid] = {"slo": ent.get("slo"),
+                             "slo_rem": ent.get("slo_rem"),
+                             "blob": blob}
+                delivered[sid] = int(ent.get("delivered", 0))
+                note(sid)
+            gone.update({sid: r
+                         for sid, r in snap.body.get("gone", [])})
+        for ev in events:
+            k = ev.get("ev")
+            if k == "admit":
+                sid = ev["sid"]
+                blob = base64.b64decode(ev["ckpt"]) \
+                    if ev.get("ckpt") else None
+                live[sid] = {"slo": ev.get("slo"), "slo_rem": None,
+                             "blob": blob}
+                delivered[sid] = max(delivered.get(sid, 0),
+                                     int(ev.get("delivered", 0)))
+                gone.pop(sid, None)
+                note(sid)
+            elif k == "mark":
+                for key, n in ev.get("d", {}).items():
+                    sid = by_str.get(key, key)
+                    delivered[sid] = max(delivered.get(sid, 0),
+                                         int(n))
+            elif k in ("shed", "close", "evict"):
+                sid = ev["sid"]
+                live.pop(sid, None)
+                gone[sid] = ev.get("reason",
+                                   "closed" if k == "close"
+                                   else "evicted")
+
+        srv = cls(config, receiver=receiver, clock=clock,
+                  registry=registry)
+        if snap is not None:
+            # continue the ABSOLUTE step/sequence lines: the fresh
+            # receiver restarts chunk_steps at 0 and a fully-pruned
+            # journal restarts seq at 0 — both must resume past the
+            # recovered snapshot or a SECOND crash rolls back to it
+            srv._step_base = int(snap.step)
+            srv._last_snap_step = int(snap.step)
+            if srv._journal is not None:
+                srv._journal.bump_seq(base_seq)
+        now = srv.clock()
+        with telemetry.collect(srv.registry):
+            srv._count("serve.restarts")
+            if rstats.dropped:
+                srv._count("serve.journal_torn_drops",
+                           rstats.dropped)
+            srv._gone.update(gone)
+            marks: Dict[str, int] = {}
+            for sid in order:
+                ent = live.get(sid)
+                if ent is None:
+                    continue
+                slo = ent["slo_rem"] if ent["slo_rem"] is not None \
+                    else ent["slo"]
+                s = _Session(sid, now, slo, ent["blob"])
+                s.dedupe_until = delivered.get(sid, 0)
+                if ent["blob"] is not None:
+                    try:
+                        st = resilience.restore_carry(ent["blob"])
+                        s.acked = int(st.offset) \
+                            + int(st.tail.shape[0])
+                    except resilience.CarryCheckpointError:
+                        pass
+                srv._sessions[sid] = s
+                srv._marked[sid] = delivered.get(sid, 0)
+                if srv._free:
+                    srv._admit(s)
+                else:
+                    # elastic repack: more live sessions than
+                    # surviving lanes — the scheduler's queue takes
+                    # the rest, restoring as lanes free
+                    srv._queue.append(sid)
+                    srv._count("serve.queued")
+                srv._j({"ev": "admit", "sid": sid, "slo": slo,
+                        "ckpt": srv._b64(
+                            ent["blob"]),
+                        "delivered": delivered.get(sid, 0)})
+                marks[str(sid)] = delivered.get(sid, 0)
+                srv.recovered[sid] = {
+                    "acked": s.acked,
+                    "dedupe_until": s.dedupe_until,
+                    "active": s.lane is not None}
+            if marks:
+                srv._j({"ev": "mark", "d": marks})
+            # rider replay: frames emitted before the crash but never
+            # durably marked delivered — re-delivered at-least-once
+            for entry in (snap.body.get("rider", [])
+                          if snap else []):
+                sid = entry["sid"]
+                if sid not in srv._sessions:
+                    continue
+                idx = int(entry["idx"])
+                if idx <= delivered.get(sid, 0):
+                    continue
+                fr = durability.decode_frame(entry["frame"])
+                srv.replayed.append((sid, fr))
+                srv._pending_marks[sid] = max(
+                    srv._pending_marks.get(sid, 0), idx)
+            if srv.replayed:
+                srv._count("serve.replayed", len(srv.replayed))
+            srv._gauges()
+        return srv
 
     # -- deadlines / shedding -------------------------------------------
 
@@ -526,6 +933,8 @@ class ServeRuntime:
     def _shed(self, sid, reason: str, t: float) -> None:
         self._gone[sid] = f"shed:{reason}"
         self._shed_log.append((sid, reason, t))
+        self._j({"ev": "shed", "sid": sid,
+                 "reason": f"shed:{reason}"})
         self._count("serve.shed", labels={"reason": reason})
 
     def _release(self, sid, shed_reason: Optional[str] = None,
@@ -544,6 +953,8 @@ class ServeRuntime:
             self._shed(sid, shed_reason, t)
         elif counted is not None:
             self._gone[sid] = counted
+            self._j({"ev": "close" if counted == "closed"
+                     else "evict", "sid": sid, "reason": counted})
             self._count(f"serve.{counted}")
         return out
 
@@ -555,6 +966,7 @@ class ServeRuntime:
         chunk), free the lane, and admit the next queued session.
         Returns the emissions (any session may ride along — the
         in-flight step drains)."""
+        self._flush_marks()
         s = self._get_session(sid)
         if s.lane is None:
             # closing a still-QUEUED session: it was never admitted,
@@ -563,6 +975,7 @@ class ServeRuntime:
             self._queue.remove(sid)
             del self._sessions[sid]
             self._gone[sid] = "closed"
+            self._j({"ev": "close", "sid": sid, "reason": "closed"})
             self._count("serve.closed_queued")
             return []
         out = []
@@ -589,6 +1002,7 @@ class ServeRuntime:
         ``connect(sid, checkpoint=blob)``. Evicting a still-QUEUED
         session returns ``(None, [], staged)`` (no lane state
         exists yet)."""
+        self._flush_marks()
         s = self._get_session(sid)
         staged = list(s.staged)
         s.staged.clear()
@@ -599,6 +1013,7 @@ class ServeRuntime:
             self._queue.remove(sid)
             del self._sessions[sid]
             self._gone[sid] = "evicted"
+            self._j({"ev": "evict", "sid": sid, "reason": "evicted"})
             self._count("serve.evicted_queued")
             return None, [], staged
         blob, got = self._rx.checkpoint(s.lane)
@@ -616,6 +1031,7 @@ class ServeRuntime:
         the final :meth:`stats`/:meth:`scrape` survive it."""
         if self._drained:
             return []
+        self._flush_marks()
         self._draining = True
         out = self._take_spill()
         now = self.clock()
@@ -630,6 +1046,11 @@ class ServeRuntime:
         # the fleet is closed: anything still pending drained above
         out += self._emit(got)
         self._drained = True
+        if self._journal is not None:
+            # every session closed above; seal the active segment so
+            # the directory holds only sealed, replay-clean files
+            self._flush_marks()
+            self._journal.close()
         self._gauges()
         return out
 
@@ -728,14 +1149,42 @@ def run_clients(srv: ServeRuntime, clients: List[ClientSpec],
         for sid, fr in pairs:
             frames[sid].append(fr)
 
+    # a recovered runtime re-delivers its snapshot rider up front
+    # (at-least-once; dedupe by frame.start if exactness matters)
+    collect((sid, fr) for sid, fr in srv.replayed
+            if sid in frames)
+
     todo = {c.sid: deque(c.schedule) for c in clients}
     pending = {c.sid: c for c in clients}       # not yet connected
     unclosed = {c.sid: c for c in clients}
+
+    def fast_forward(sid):
+        """A RECOVERED session is already live ('duplicate'): resume
+        its schedule from the server's acked coordinate — everything
+        below it is inside the restored lane state (the documented
+        resubmission protocol, docs/robustness.md)."""
+        skip = srv.acked(sid)
+        q = todo[sid]
+        while q and skip > 0:
+            t, slab = q[0]
+            n = slab.shape[0]
+            if n <= skip:
+                q.popleft()
+                skip -= n
+            else:
+                q[0] = (t, slab[skip:])
+                skip = 0
+
     tick = 0
     while tick <= max_ticks:
         for sid in list(pending):
             r = srv.connect(sid, slo_s=pending[sid].slo_s)
             if r.admitted or r.queued:
+                del pending[sid]
+            elif r.reason == "duplicate":
+                # recovered session (active, or queued behind the
+                # elastic repack): resume, don't re-stream
+                fast_forward(sid)
                 del pending[sid]
         for c in clients:
             if c.sid in pending:
@@ -806,11 +1255,27 @@ def main(argv=None) -> int:
     p.add_argument("--metrics-dump", action="store_true",
                    help="print the Prometheus exposition to stderr "
                         "at exit")
+    p.add_argument("--snapshot-dir", metavar="DIR", default=None,
+                   help="durability directory: write-ahead journal + "
+                        "automatic fleet snapshots (docs/robustness.md"
+                        "; ServeRuntime.recover(DIR) resumes a "
+                        "crashed run)")
+    p.add_argument("--snapshot-every", type=int, default=8,
+                   metavar="N",
+                   help="chunk-steps between automatic snapshots "
+                        "(with --snapshot-dir; default 8)")
+    p.add_argument("--recover", action="store_true",
+                   help="recover the fleet from --snapshot-dir "
+                        "instead of starting fresh")
     args = p.parse_args(argv)
 
+    if args.recover and not args.snapshot_dir:
+        raise SystemExit("--recover needs --snapshot-dir")
     cfg = ServeConfig(n_lanes=args.lanes, chunk_len=args.chunk_len,
                       frame_len=args.frame_len, check_fcs=True,
-                      default_slo_s=args.slo)
+                      default_slo_s=args.slo,
+                      snapshot_dir=args.snapshot_dir,
+                      snapshot_every=args.snapshot_every)
     misbehave = {0: "nan"} if args.nan_client else {}
     clients = synth_load(args.sessions, args.frames, seed=args.seed,
                          misbehave=misbehave, tail=args.frame_len)
@@ -821,7 +1286,8 @@ def main(argv=None) -> int:
         except ValueError as e:
             raise SystemExit(f"--chaos: {e}")
 
-    srv = ServeRuntime(cfg)
+    srv = ServeRuntime.recover(args.snapshot_dir, config=cfg) \
+        if args.recover else ServeRuntime(cfg)
     frames: Dict[Any, List] = {}
     import contextlib
     try:

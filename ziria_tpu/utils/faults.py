@@ -32,6 +32,11 @@ Two seam families consume the plan:
   NaN-poisons a deterministic fraction of the rows, ``truncate`` drops
   a deterministic tail fraction — the two input-poisoning faults the
   quarantine machinery exists to contain.
+- **io seams** call :func:`io_fault(site, data) <io_fault>` on every
+  byte payload the durability layer (``runtime/durability.py``) is
+  about to write: ``io_torn`` truncates the write (the torn-record
+  crash the CRC framing catches on replay), ``io_enospc`` raises
+  ``OSError(ENOSPC)`` like a full disk.
 
 Sites are matched by :mod:`fnmatch` pattern, so one spec can cover a
 family (``"rx.push.s*"`` — note fnmatch treats ``[...]`` as a
@@ -67,11 +72,14 @@ _LOCK = threading.Lock()            # guards (de)activation only
 _PLANS: Tuple["FaultPlan", ...] = ()
 
 #: the injectable fault classes (docs/robustness.md taxonomy)
-KINDS = ("nan_slab", "truncate", "transient", "fatal", "delay", "hang")
+KINDS = ("nan_slab", "truncate", "transient", "fatal", "delay", "hang",
+         "io_torn", "io_enospc")
 
-#: kinds that act at data (push) seams vs dispatch seams
+#: kinds that act at data (push) seams vs dispatch seams vs the
+#: durability write seams (journal append / snapshot file writes)
 DATA_KINDS = ("nan_slab", "truncate")
 DISPATCH_KINDS = ("transient", "fatal", "delay", "hang")
+IO_KINDS = ("io_torn", "io_enospc")
 
 
 class InjectedFault(Exception):
@@ -266,6 +274,34 @@ def corrupt_slab(site: str, arr: np.ndarray):
             arr = arr[:keep]
         kinds.append(sp.kind)
     return arr, tuple(kinds)
+
+
+def io_fault(site: str, data: bytes) -> bytes:
+    """The durability write seam (runtime/durability.py calls this on
+    every byte payload it is about to put on disk — journal record
+    frames and snapshot files alike). A matching ``io_torn`` spec
+    returns a TRUNCATED prefix of ``data`` (at least one byte dropped
+    — the torn-write crash the CRC framing exists to catch); an
+    ``io_enospc`` spec raises ``OSError(ENOSPC)`` exactly as a full
+    disk would. Free when no plan is active (one truthiness check)."""
+    if not _PLANS:
+        return data
+    import errno
+
+    for plan in _PLANS:
+        got = plan.decide(site, IO_KINDS)
+        if got is None:
+            continue
+        sp, idx = got
+        if sp.kind == "io_enospc":
+            raise OSError(
+                errno.ENOSPC,
+                f"No space left on device (injected at {site}, "
+                f"call {idx})")
+        keep = min(len(data) - 1,
+                   int(len(data) * (1.0 - sp.fraction)))
+        data = data[: max(0, keep)]
+    return data
 
 
 # ----------------------------------------------------------- env knob
