@@ -111,7 +111,50 @@ def main() -> int:
     except rz.CarryCheckpointError:
         pass
 
-    # 7. disabled-path pin: the seams are free when no plan is active
+    # 7. the channel-profile grammar (ISSUE 15): parses jax-free,
+    # validates names against the profile registry, and the `channel`
+    # data kind corrupts slabs deterministically in pure numpy — the
+    # precommit gate keeps working through TPU probe hangs
+    from ziria_tpu.phy import profiles as chp
+
+    assert "jax" not in sys.modules, \
+        "phy/profiles imported jax — the registry must stay host-only"
+    assert chp.parse_profile_spec("flat,severe") == ("flat", "severe")
+    assert chp.resolve_profiles("flat", 4) is None, \
+        "flat must resolve to the unprofiled path"
+    assert chp.resolve_profiles(("flat", "severe"), 4) == \
+        ("flat", "severe", "flat", "severe")
+    for name, prof in chp.CHANNEL_PROFILES.items():
+        e = sum(r * r + i * i for r, i in prof.taps)
+        assert abs(e - 1.0) < 1e-6, f"{name} taps not unit-energy"
+    try:
+        chp.parse_profile_spec("nope")
+        raise AssertionError("unknown profile must not parse")
+    except ValueError:
+        pass
+    specs, cseed = faults.parse_chaos_spec(
+        "seed=5;rx.push.s*:channel:profile=severe,every=2")
+    assert specs[0].profile == "severe" and specs[0].every == 2
+    slab = np.ones((64, 2), np.float32)
+    outs = []
+    for _ in range(2):
+        with faults.inject(*specs, seed=cseed):
+            a0, k0 = faults.corrupt_slab("rx.push.s0", slab)
+            a1, k1 = faults.corrupt_slab("rx.push.s0", slab)
+        assert k0 == () and k1 == ("channel",)
+        outs.append(a1)
+    assert np.array_equal(outs[0], outs[1]), "channel kind must replay"
+    assert not np.array_equal(outs[0], slab), "channel kind must act"
+    assert outs[0].shape == slab.shape
+    try:
+        faults.parse_chaos_spec("x:channel:profile=nope")
+        raise AssertionError("bad channel profile must not parse")
+    except ValueError:
+        pass
+    assert "jax" not in sys.modules, \
+        "channel-kind corruption imported jax — must stay host-only"
+
+    # 8. disabled-path pin: the seams are free when no plan is active
     assert not faults.active()
     n = 20000
     t0 = time.perf_counter()

@@ -547,6 +547,99 @@ def ber_sweep_stats(n_frames=16, n_bytes=50, rates=(6, 24, 54),
     }
 
 
+#: per-profile BER-envelope bounds at the TOP of the sweep's SNR grid
+#: (the "bounded error floor at high SNR" acceptance gates of ISSUE
+#: 15). flat must be error-free at high SNR; the equalizable profiles
+#: (multipath-only) must stay near-clean through the LTS/ZF front
+#: end; the burst/SCO/drift profiles are ALLOWED a floor — bounded,
+#: never unbounded garbage. Calibrated with >= 3x margin over
+#: measured CPU values at the bench geometry.
+CHANNEL_BER_ENVELOPES = {
+    "flat": 0.0, "mild": 0.02, "urban": 0.05, "severe": 0.15,
+    "sco": 0.10, "doppler": 0.10, "bursty": 0.30, "hostile": 0.30,
+}
+
+
+def channel_sweep_stats(n_frames=8, n_bytes=24, rates=(6, 24, 54),
+                        snrs=(12.0, 30.0), seeds=(7,),
+                        profiles=("flat", "mild", "urban", "severe",
+                                  "sco", "doppler", "bursty",
+                                  "hostile")):
+    """The channel-hostile BER gate (ISSUE 15): a rates x SNR x
+    PROFILE waterfall through `link.sweep_ber`'s profile axis — STILL
+    one `lax.scan` dispatch — gated three ways:
+
+    - the ``flat`` column's error counts are bit-identical to the
+      profile-less sweep (flat IS the unprofiled channel);
+    - every profile's BER at the TOP SNR point stays under its
+      `CHANNEL_BER_ENVELOPES` bound (bounded error floors — a deep
+      fade degrades, it never explodes);
+    - BER is non-increasing in SNR per profile within counting noise
+      (the waterfall actually falls).
+
+    Records ``ber_floor_<profile>`` per profile (the BENCH_TRAJECTORY
+    metrics; lower is better) plus sweep timing. Returns a flat
+    dict."""
+    from ziria_tpu.phy import link
+    from ziria_tpu.utils.dispatch import count_dispatches
+
+    if "flat" not in profiles:
+        # the stage IS the flat-identity gate: without the anchor
+        # column the base-sweep comparison would be vacuous and the
+        # ledger would record a gate that never ran
+        raise ValueError("channel_sweep_stats needs 'flat' in "
+                         "profiles (the identity-anchor column)")
+    rng = np.random.default_rng(15)
+    psdus = rng.integers(0, 256, (n_frames, n_bytes)).astype(np.uint8)
+    bits_total = n_frames * 8 * n_bytes
+
+    base = link.sweep_ber(psdus, rates, snrs, seeds)
+    with count_dispatches() as d_sw:
+        errs = link.sweep_ber(psdus, rates, snrs, seeds,
+                              profiles=profiles)
+    t_sw = _timed(lambda: link.sweep_ber(psdus, rates, snrs, seeds,
+                                         profiles=profiles))
+    assert errs.shape == (len(rates), len(profiles), len(snrs),
+                          len(seeds)), errs.shape
+
+    flat_cols = [pi for pi, p in enumerate(profiles) if p == "flat"]
+    flat_identical = all(
+        np.array_equal(errs[:, pi], base) for pi in flat_cols)
+    assert flat_identical, \
+        "flat profile column diverged from the unprofiled sweep"
+
+    floors, monotone = {}, {}
+    for pi, p in enumerate(profiles):
+        # BER per SNR point, averaged over rates and seeds
+        ber = errs[:, pi].sum(axis=(0, 2)) \
+            / (len(rates) * len(seeds) * bits_total)
+        floors[p] = float(ber[-1])
+        bound = CHANNEL_BER_ENVELOPES[p]
+        assert ber[-1] <= bound, \
+            (f"profile {p}: BER floor {ber[-1]:.4f} at "
+             f"{snrs[-1]} dB exceeds its {bound} envelope")
+        # counting noise on a small smoke grid: allow a 2e-3 rise
+        monotone[p] = bool(np.all(np.diff(ber) <= 2e-3))
+        assert monotone[p], f"profile {p}: BER rose with SNR: {ber}"
+
+    n_points = len(rates) * len(snrs) * len(seeds) * len(profiles)
+    out = {
+        "frames": n_frames, "frame_bytes": n_bytes,
+        "rates": list(rates), "snrs": list(snrs),
+        "seeds": list(seeds), "profiles": list(profiles),
+        "points": n_points,
+        "dispatches_sweep": d_sw.total,
+        "dispatch_times_ms_sweep": d_sw.times_ms(),
+        "t_sweep_s": round(t_sw, 4),
+        "points_per_s_sweep": round(n_points / t_sw, 2),
+        "flat_identical": flat_identical,
+        "envelopes": {p: CHANNEL_BER_ENVELOPES[p] for p in profiles},
+    }
+    for p, v in floors.items():
+        out[f"ber_floor_{p}"] = round(v, 6)
+    return out
+
+
 def streaming_stats(n_frames=16, n_bytes=12, snr_db=30.0,
                     chunk_len=4096, frame_len=1024, k=8,
                     trace_path=None):
@@ -1525,6 +1618,9 @@ def main():
         out["fused_link"] = fused_link_stats(n_bytes=24)
         out["ber_sweep"] = ber_sweep_stats(
             n_frames=8, n_bytes=24, rates=(6, 54), snrs=(3.0, 8.0))
+        out["channel_sweep"] = channel_sweep_stats(
+            n_frames=4, n_bytes=24, rates=(6, 54),
+            profiles=("flat", "severe", "sco", "bursty", "hostile"))
         out["streaming_rx"] = streaming_stats(n_frames=8)
         out["multi_stream"] = multi_stream_stats(
             n_streams=4, frames_per_stream=2)
@@ -1543,6 +1639,7 @@ def main():
         out["link_loopback"] = link_loopback_stats()
         out["fused_link"] = fused_link_stats()
         out["ber_sweep"] = ber_sweep_stats()
+        out["channel_sweep"] = channel_sweep_stats()
         out["streaming_rx"] = streaming_stats()
         out["multi_stream"] = multi_stream_stats()
         out["resilience"] = resilience_stats()

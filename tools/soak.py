@@ -83,11 +83,13 @@ def _same(a, b) -> bool:
             and a.result.crc_ok == b.result.crc_ok)
 
 
-def _clients(n_sessions: int, frames_per_session: int, seed: int):
+def _clients(n_sessions: int, frames_per_session: int, seed: int,
+             channel_profile=None):
     from ziria_tpu.runtime import serve
     return serve.synth_load(n_sessions, frames_per_session,
                             n_bytes=N_BYTES, snr_db=30.0, seed=seed,
-                            tail=GEO["frame_len"])
+                            tail=GEO["frame_len"],
+                            channel_profile=channel_profile)
 
 
 def _oracle(clients):
@@ -421,16 +423,27 @@ def soak_stats(n_sessions: int = 3, n_lanes: int = 4,
                frames_per_session: int = 4, rounds: int = 3,
                sigkill_rounds: int = 1, seed: int = 20260804,
                recovery_slo_s: float = 30.0,
-               tick_sleep: float = 0.05) -> dict:
+               tick_sleep: float = 0.05,
+               channel_profile: str = "urban") -> dict:
     """The bench-facing campaign (``bench.py soak``): in-process
     fault rounds (alternating clean-data / dirty-data spec draws) +
     real SIGKILL subprocess rounds, all gated, recovery latencies
-    aggregated to the ledger metric ``recovery_p99_s``."""
+    aggregated to the ledger metric ``recovery_p99_s``. The campaign
+    additionally runs ONE multipath-active round (ISSUE 15): every
+    client's stream rides the named physical-channel profile
+    (phy/profiles; an equalizable tap set, so the oracle is complete)
+    while the usual dispatch/io faults fire and the server crashes
+    and recovers — physical faults and software faults campaigned
+    TOGETHER, gated on zero crashes and the same per-session
+    bit-identity vs the profiled oracle."""
     from ziria_tpu.runtime import serve
 
     clients = _clients(n_sessions, frames_per_session, seed)
     oracle = _oracle(clients)
     n_oracle = sum(len(v) for v in oracle.values())
+    chan_clients = _clients(n_sessions, frames_per_session, seed + 1,
+                            channel_profile=channel_profile)
+    chan_oracle = _oracle(chan_clients)
 
     times: list = []
     by_kind: dict = {}
@@ -472,6 +485,23 @@ def soak_stats(n_sessions: int = 3, n_lanes: int = 4,
             for k in totals:
                 totals[k] += ev[k]
 
+        # the multipath-active crash->recover round: profiled client
+        # streams (same geometry, own oracle) under a DIRTY-round
+        # fault draw (dispatch + io + data-poisoning kinds) —
+        # physical chaos UNDER software chaos, one campaign
+        d = os.path.join(root, "round-channel")
+        cfg = serve.ServeConfig(
+            n_lanes=n_lanes, queue_cap=16, sanitize=True,
+            watchdog_s=2.0, snapshot_dir=d, snapshot_every=1,
+            **GEO)
+        chan_ev = run_round(chan_clients, chan_oracle, cfg,
+                            seed + 991, dirty=True)
+        times.append(chan_ev["recovery_s"])
+        for k, v in chan_ev["by_kind"].items():
+            by_kind[k] = by_kind.get(k, 0) + v
+        for k in totals:
+            totals[k] += chan_ev[k]
+
         kills = {"killed": 0, "kill_missed": 0}
         for r in range(sigkill_rounds):
             d = os.path.join(root, f"kill-{r}")
@@ -504,6 +534,9 @@ def soak_stats(n_sessions: int = 3, n_lanes: int = 4,
             "dispatches_per_chunk_step_post_recovery": dpcs,
             "budget_checked": budget_checked,
             "kills": kills, "identity": "bit_identical",
+            "channel_profile": channel_profile,
+            "channel_round_frames": chan_ev["frames_checked"],
+            "channel_round_faults": chan_ev["faults"],
             "zero_crashes": True}
 
 

@@ -211,7 +211,8 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
                  viterbi_window: int = None,
                  viterbi_metric: str = None,
                  viterbi_radix: int = None,
-                 batched_acquire: Optional[bool] = None) -> List[Any]:
+                 batched_acquire: Optional[bool] = None,
+                 sco_track: Optional[bool] = None) -> List[Any]:
     """Frame-batched library receiver: N independent captures -> N
     :class:`rx.RxResult`s in O(1) device dispatches — acquire ->
     gather -> mixed-rate decode:
@@ -246,6 +247,7 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
     from ziria_tpu.phy.wifi import rx as _rx
 
     batched_acquire = batched_acquire_enabled(batched_acquire)
+    sco_track = _rx.sco_track_enabled(sco_track)
 
     results: List[Any] = [None] * len(captures)
     if batched_acquire:
@@ -274,13 +276,13 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
                           for _i, a in padded])
     return _mixed_decode_tail(acqs, padded, segs, n_sym_b, results,
                               check_fcs, viterbi_window, viterbi_metric,
-                              viterbi_radix)
+                              viterbi_radix, sco_track)
 
 
 def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
                        results: List[Any], check_fcs: bool,
                        viterbi_window, viterbi_metric,
-                       viterbi_radix=None):
+                       viterbi_radix=None, sco_track: bool = False):
     """The shared tail of every batched receive surface: ONE
     mixed-rate decode dispatch over the lane-padded segments, plus —
     when FCS checking is on — ONE vmapped masked-CRC dispatch at the
@@ -307,7 +309,8 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
         jnp.int32)
     dec = _rx._jit_decode_data_mixed(n_sym_b, viterbi_window,
                                      viterbi_metric,
-                                     _check_radix(viterbi_radix))
+                                     _check_radix(viterbi_radix),
+                                     sco_track)
     programs.note_site("rx.decode_mixed", dec, segs, ridx, nbits)
     with dispatch.timed("rx.decode_mixed"):
         clear_dev = dec(segs, ridx, nbits)
@@ -335,7 +338,8 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
 def receive_many_device(x_dev, n_lanes: int, check_fcs: bool = False,
                         viterbi_window: int = None,
                         viterbi_metric: str = None,
-                        viterbi_radix: int = None) -> List[Any]:
+                        viterbi_radix: int = None,
+                        sco_track: Optional[bool] = None) -> List[Any]:
     """Batched receive over an ALREADY device-resident capture batch —
     the RX side of the loopback link (phy/link.py): the channel's
     output feeds acquisition without the samples ever crossing the
@@ -367,7 +371,8 @@ def receive_many_device(x_dev, n_lanes: int, check_fcs: bool = False,
         x_dev, [a for _i, a in padded], n_sym_b)
     return _mixed_decode_tail(lanes, padded, segs, n_sym_b, results,
                               check_fcs, viterbi_window, viterbi_metric,
-                              viterbi_radix)
+                              viterbi_radix,
+                              _rx.sco_track_enabled(sco_track))
 
 
 # ------------------------------------------------------ streaming receiver
@@ -528,6 +533,11 @@ class _LaneHealth:
         return False
 
 
+#: geometry keys that postdate shipped checkpoint blobs, mapped to
+#: the behavior the pre-key code had (see _validate_checkpoint)
+_LEGACY_GEOMETRY_DEFAULTS = {"sco_track": False}
+
+
 def _validate_checkpoint(st, mine: dict) -> None:
     """The ONE checkpoint-geometry gate of every restore surface
     (``StreamReceiver(checkpoint=...)`` and the fleet's
@@ -537,15 +547,23 @@ def _validate_checkpoint(st, mine: dict) -> None:
     arbitrary receiver) or disagrees with the restoring receiver."""
     from ziria_tpu.runtime import resilience
 
-    missing = [k_ for k_ in mine if k_ not in st.geometry]
+    # geometry fields added AFTER a blob format shipped, with the
+    # value the old code behaved as: a legacy blob missing one of
+    # these restores as that default instead of refusing — the old
+    # decode program IS the default-mode program, so refusing would
+    # throw away valid saved state on every deploy of a new knob
+    geo = dict(st.geometry)
+    for k_, v_ in _LEGACY_GEOMETRY_DEFAULTS.items():
+        geo.setdefault(k_, v_)
+    missing = [k_ for k_ in mine if k_ not in geo]
     if missing:
         raise resilience.CarryCheckpointError(
             f"checkpoint lacks geometry fields {missing}; "
             f"use StreamReceiver.checkpoint() (or pass the "
             f"receiver geometry to checkpoint_carry) so the "
             f"restore can be validated")
-    bad = {k_: (st.geometry[k_], mine[k_]) for k_ in mine
-           if st.geometry[k_] != mine[k_]}
+    bad = {k_: (geo[k_], mine[k_]) for k_ in mine
+           if geo[k_] != mine[k_]}
     if bad:
         raise resilience.CarryCheckpointError(
             f"checkpoint geometry mismatch (checkpoint, "
@@ -565,7 +583,8 @@ def _stream_geometry(r) -> dict:
             "dead_zone": r._dead_zone,
             "viterbi_window": r.viterbi_window,
             "viterbi_metric": r.viterbi_metric,
-            "viterbi_radix": r.viterbi_radix}
+            "viterbi_radix": r.viterbi_radix,
+            "sco_track": bool(r.sco_track)}
 
 
 def _pull_chunk(outs):
@@ -685,7 +704,8 @@ class StreamReceiver:
                  max_retries: Optional[int] = None,
                  watchdog_s: Optional[float] = None,
                  blowup_limit: int = 2, rejoin_after: int = 3,
-                 checkpoint: Optional[bytes] = None):
+                 checkpoint: Optional[bytes] = None,
+                 sco_track: Optional[bool] = None):
         from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
         from ziria_tpu.runtime import resilience
@@ -712,9 +732,12 @@ class StreamReceiver:
         self.check_fcs = check_fcs
         self.viterbi_window = viterbi_window
         self.viterbi_metric = viterbi_metric
-        # resolved ONCE at construction: the radix is part of the
-        # stream's fixed compiled geometry (decode jit cache key)
+        # resolved ONCE at construction: the radix and sco_track are
+        # part of the stream's fixed compiled geometry (decode jit
+        # cache key AND the checkpoint fingerprint — a different
+        # decode program emits different bits)
         self.viterbi_radix = _check_radix(viterbi_radix)
+        self.sco_track = _rx.sco_track_enabled(sco_track)
         self.streaming = streaming_rx_enabled(streaming)
         # detector params kept for the degraded eager twin (the same
         # chunk graph run op-by-op when the compiled program fails)
@@ -1010,7 +1033,8 @@ class StreamReceiver:
             dec = _rx._jit_stream_decode(self.n_sym_bucket,
                                          self.viterbi_window,
                                          self.viterbi_metric,
-                                         self.viterbi_radix)
+                                         self.viterbi_radix,
+                                         self.sco_track)
             programs.note_site("rx.stream_decode", dec, segs, rows,
                                ridx, nbits, npsdu)
             got = _guarded_decode(
@@ -1060,7 +1084,8 @@ class StreamReceiver:
                     win, check_fcs=self.check_fcs,
                     viterbi_window=self.viterbi_window,
                     viterbi_metric=self.viterbi_metric,
-                    viterbi_radix=self.viterbi_radix)
+                    viterbi_radix=self.viterbi_radix,
+                    sco_track=self.sco_track)
             except Exception:    # noqa: BLE001 - counted containment
                 if not contain:
                     raise
@@ -1124,7 +1149,8 @@ def receive_stream(samples, chunk_len: int = 1 << 13,
                    dead_zone: int = 320, viterbi_window: int = None,
                    viterbi_metric: str = None,
                    viterbi_radix: int = None,
-                   streaming: Optional[bool] = None):
+                   streaming: Optional[bool] = None,
+                   sco_track: Optional[bool] = None):
     """Decode every frame of a long multi-frame sample stream in
     O(chunks) device dispatches (<= 2 per chunk; 1 for all-noise
     chunks). Returns ``(frames, stats)``: a position-ordered list of
@@ -1148,7 +1174,7 @@ def receive_stream(samples, chunk_len: int = 1 << 13,
                         viterbi_window=viterbi_window,
                         viterbi_metric=viterbi_metric,
                         viterbi_radix=viterbi_radix,
-                        streaming=streaming)
+                        streaming=streaming, sco_track=sco_track)
     frames = sr.push(samples)
     frames += sr.flush()
     return frames, sr.stats
@@ -1236,7 +1262,8 @@ class MultiStreamReceiver:
                  axis: str = "dp", sanitize: bool = False,
                  max_retries: Optional[int] = None,
                  watchdog_s: Optional[float] = None,
-                 blowup_limit: int = 2, rejoin_after: int = 3):
+                 blowup_limit: int = 2, rejoin_after: int = 3,
+                 sco_track: Optional[bool] = None):
         from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
         from ziria_tpu.runtime import resilience
@@ -1269,6 +1296,7 @@ class MultiStreamReceiver:
         self.viterbi_window = viterbi_window
         self.viterbi_metric = viterbi_metric
         self.viterbi_radix = _check_radix(viterbi_radix)
+        self.sco_track = _rx.sco_track_enabled(sco_track)
         self.mesh = mesh
         self.axis = axis
         self._threshold = float(threshold)
@@ -1766,7 +1794,7 @@ class MultiStreamReceiver:
             dec = _rx._jit_stream_decode_multi(
                 self.n_sym_bucket, self.viterbi_window,
                 self.viterbi_metric, self.viterbi_radix,
-                self.mesh, self.axis)
+                self.mesh, self.axis, self.sco_track)
             dec_args = (segs, self._put(rows), self._put(ridx),
                         self._put(nbits), self._put(npsdu))
             programs.note_site("rx.stream_decode_multi", dec, *dec_args)
@@ -1819,7 +1847,8 @@ class MultiStreamReceiver:
                     win, check_fcs=self.check_fcs,
                     viterbi_window=self.viterbi_window,
                     viterbi_metric=self.viterbi_metric,
-                    viterbi_radix=self.viterbi_radix)
+                    viterbi_radix=self.viterbi_radix,
+                    sco_track=self.sco_track)
             except Exception:    # noqa: BLE001 - counted containment
                 self._lane_blowups += 1
                 self._health[i].blowup()
@@ -1870,7 +1899,8 @@ def receive_streams(streams, chunk_len: int = 1 << 13,
                     viterbi_metric: str = None,
                     viterbi_radix: int = None,
                     multi: Optional[bool] = None, mesh=None,
-                    axis: str = "dp"):
+                    axis: str = "dp",
+                    sco_track: Optional[bool] = None):
     """Decode S concurrent multi-frame I/Q streams in O(chunk-steps)
     device dispatches — <= 2 per chunk-step *independent of S*.
     Returns ``(per_stream_frames, stats)``: a per-stream position-
@@ -1895,7 +1925,7 @@ def receive_streams(streams, chunk_len: int = 1 << 13,
               min_run=min_run, dead_zone=dead_zone,
               viterbi_window=viterbi_window,
               viterbi_metric=viterbi_metric,
-              viterbi_radix=viterbi_radix)
+              viterbi_radix=viterbi_radix, sco_track=sco_track)
     if not multi_stream_enabled(multi):
         if mesh is not None:
             # a sharded-vs-oracle comparison must never silently

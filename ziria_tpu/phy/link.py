@@ -49,6 +49,7 @@ import numpy as np
 from ziria_tpu.backend import framebatch
 from ziria_tpu.ops.viterbi import _check_radix
 from ziria_tpu.phy import channel
+from ziria_tpu.phy import profiles as chanprof
 from ziria_tpu.phy.wifi import rx, tx
 from ziria_tpu.phy.wifi.params import N_SERVICE_BITS, \
     RATE_MBPS_ORDER, RATES, n_symbols
@@ -105,19 +106,27 @@ def _lane_param(v, n: int, dtype) -> np.ndarray:
     return np.broadcast_to(np.asarray(v, dtype), (n,)).copy()
 
 
-def _link_buckets(psdus, rates_mbps, add_fcs: bool, dly_max: int):
+def _link_buckets(psdus, rates_mbps, add_fcs: bool, dly_max: int,
+                  tap_pad: int = 0):
     """The ONE derivation of the link's (symbol bucket, capture
     bucket): the common symbol bucket's frame length plus the worst
-    delay, at the receiver's capture-bucket rule. Every loopback mode
-    — fused, staged, per-frame — calls this, because a lane's noise
-    field is drawn over the whole capture buffer: buffer sizes ARE
-    semantics, and a drift here would silently break the lane-for-lane
+    delay, at the receiver's capture-bucket rule. ``tap_pad`` is the
+    profiled channel's FIR ring headroom (max tap count - 1, zero for
+    the unprofiled/flat link so those buckets are untouched): the
+    multipath tail smears that many samples past the frame, and
+    without the margin a lane whose delay + frame length lands
+    exactly on the power-of-two bucket would wrap the ring onto the
+    capture HEAD via the delay roll. Every loopback mode — fused,
+    staged, per-frame — calls this, because a lane's noise field is
+    drawn over the whole capture buffer: buffer sizes ARE semantics,
+    and a drift here would silently break the lane-for-lane
     bit-identity contract."""
     fcs_bytes = 4 if add_fcs else 0
     sym_b = max(tx._sym_bucket(n_symbols(
         int(np.asarray(p).size) + fcs_bytes, RATES[m]))
         for p, m in zip(psdus, rates_mbps))
-    return sym_b, rx._stream_bucket(400 + 80 * sym_b + int(dly_max))
+    return sym_b, rx._stream_bucket(400 + 80 * sym_b + int(dly_max)
+                                    + int(tap_pad))
 
 
 class _LinkGeometry:
@@ -127,7 +136,8 @@ class _LinkGeometry:
     transmit surfaces) plus the link-side row tables (channel params,
     capture bucket, per-lane decode bit counts)."""
 
-    def __init__(self, psdus, rates_mbps, snr, eps, dly, add_fcs):
+    def __init__(self, psdus, rates_mbps, snr, eps, dly, add_fcs,
+                 tap_pad: int = 0):
         n = len(psdus)
         self.n = n
         prep = tx.batch_host_prep(psdus, rates_mbps, add_fcs)
@@ -138,7 +148,8 @@ class _LinkGeometry:
         self.nbits_b = prep.nbits_b
         self.ridx_b = prep.ridx_b
         _sym_b2, self.l_cap = _link_buckets(psdus, rates_mbps,
-                                            add_fcs, int(dly.max()))
+                                            add_fcs, int(dly.max()),
+                                            tap_pad)
         if _sym_b2 != self.sym_b:       # one rule, two call shapes
             raise AssertionError(
                 f"link bucket rule drifted: {_sym_b2} != {self.sym_b}")
@@ -167,7 +178,9 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
                   fused: Optional[bool] = None,
                   viterbi_window: int = None,
                   viterbi_metric: str = None,
-                  viterbi_radix: int = None) -> List:
+                  viterbi_radix: int = None,
+                  channel_profile=None,
+                  sco_track: Optional[bool] = None) -> List:
     """The full N-frame mixed-rate loopback. Default: the FUSED path —
     encode → per-lane channel impairments → acquire → classify →
     gather → mixed-rate decode → batched CRC as ONE jitted device
@@ -180,10 +193,19 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
     ``snr_db``/``cfo``/``delay`` are scalars or per-lane sequences
     (``np.inf`` SNR disables noise exactly); lane noise keys derive
     from ``seed`` by counter fold-in, so lane i sees the same channel
-    whether it runs fused, staged, or alone. Returns per-frame
-    :class:`rx.RxResult`, lane-for-lane bit-identical across all three
-    modes — including no-detect / bad-parity / truncated lanes and
-    ``check_fcs=True``."""
+    whether it runs fused, staged, or alone. ``channel_profile`` is a
+    profile name / per-lane sequence / None (-> the
+    ``ZIRIA_CHANNEL_PROFILE`` default; `profiles.resolve_profiles` —
+    all-flat IS the unprofiled channel by construction), applied as
+    vmapped per-lane taps/SCO/drift/bursts inside the SAME dispatches;
+    ``sco_track`` opts the decode into the pilot phase-ramp tracking
+    (``ZIRIA_RX_SCO_TRACK``). Returns per-frame :class:`rx.RxResult`,
+    lane-for-lane bit-identical across all three modes — including
+    no-detect / bad-parity / truncated lanes and ``check_fcs=True``.
+    (Profiled lanes' channel SAMPLES may differ by one float32 ulp
+    between the separately compiled mode programs — the
+    FMA-contraction rule — but the decoded RxResults are pinned
+    equal lane for lane: tests/test_channel_profiles.py.)"""
     n = len(psdus)
     if len(rates_mbps) != n:
         raise ValueError(f"{n} PSDUs but {len(rates_mbps)} rates")
@@ -194,14 +216,21 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
     dly = _lane_param(delay, n, np.int32)
     if (dly < 0).any():
         raise ValueError("negative delay")
+    # resolved ONCE here so the per-frame oracle, the staged path, and
+    # the fused graph's compile-cache key all see the same radix,
+    # per-lane profile names, and sco_track value
+    viterbi_radix = _check_radix(viterbi_radix)
+    prof_key = chanprof.resolve_profiles(channel_profile, n)
+    sco_track = rx.sco_track_enabled(sco_track)
+    # profiled links reserve FIR-ring headroom in the capture bucket
+    # (max taps - 1; zero for flat/None, so those buckets — and their
+    # noise-draw geometry — are byte-for-byte today's)
+    tap_pad = 0 if prof_key is None else max(
+        len(chanprof.get_profile(nm).taps) for nm in prof_key) - 1
     # the shared bucket rule, from byte counts alone — the per-frame
     # oracle never pays the padded-batch construction
     _sym_b, l_cap = _link_buckets(psdus, rates_mbps, add_fcs,
-                                  int(dly.max()))
-
-    # resolved ONCE here so the per-frame oracle, the staged path, and
-    # the fused graph's compile-cache key all see the same radix
-    viterbi_radix = _check_radix(viterbi_radix)
+                                  int(dly.max()), tap_pad)
     if not batched_tx_enabled(batched_tx):
         # the per-frame oracle: same channel physics, one frame at a
         # time, through the per-capture receiver
@@ -209,27 +238,36 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
         for i in range(n):
             s = np.asarray(tx.encode_frame(psdus[i], rates_mbps[i],
                                            add_fcs=add_fcs))
-            cap = channel.impair_one(s, snr[i], eps[i], int(dly[i]),
-                                     seed, i, l_cap)
+            cap = channel.impair_one(
+                s, snr[i], eps[i], int(dly[i]), seed, i, l_cap,
+                profile=None if prof_key is None else prof_key[i])
             results.append(rx.receive(np.asarray(cap),
                                       check_fcs=check_fcs,
                                       viterbi_window=viterbi_window,
                                       viterbi_metric=viterbi_metric,
-                                      viterbi_radix=viterbi_radix))
+                                      viterbi_radix=viterbi_radix,
+                                      sco_track=sco_track))
         return results
 
-    geo = _LinkGeometry(psdus, rates_mbps, snr, eps, dly, add_fcs)
+    geo = _LinkGeometry(psdus, rates_mbps, snr, eps, dly, add_fcs,
+                        tap_pad)
+    # lane-pad the profile names exactly as every other row table
+    # (lane 0 repeated), so pad rows ride lane 0's channel
+    prof_rows = None if prof_key is None else tuple(
+        prof_key[i] for i in pad_lanes(list(range(n))))
     if fused_link_enabled(fused):
         return _loopback_fused(geo, seed, check_fcs,
                                viterbi_window, viterbi_metric,
-                               viterbi_radix)
+                               viterbi_radix, prof_rows, sco_track)
     return _loopback_staged(geo, seed, check_fcs, viterbi_window,
-                            viterbi_metric, viterbi_radix)
+                            viterbi_metric, viterbi_radix, prof_rows,
+                            sco_track)
 
 
 def _loopback_staged(geo: _LinkGeometry, seed, check_fcs,
                      viterbi_window, viterbi_metric,
-                     viterbi_radix=None) -> List:
+                     viterbi_radix=None, prof_rows=None,
+                     sco_track: bool = False) -> List:
     """The staged ~5-dispatch batched loopback (the fused graph's
     bit-identical oracle): one encode_many dispatch, one impair_many
     dispatch, then receive_many_device's acquire → gather → decode
@@ -242,24 +280,29 @@ def _loopback_staged(geo: _LinkGeometry, seed, check_fcs,
         samples = enc_fn(*enc_args)
     caps = channel.impair_many(
         samples, geo.nv_tx, geo.snr, geo.eps, geo.dly, seed,
-        out_len=geo.l_cap)
+        out_len=geo.l_cap, profile=prof_rows)
     return framebatch.receive_many_device(
         caps, geo.n, check_fcs=check_fcs,
         viterbi_window=viterbi_window, viterbi_metric=viterbi_metric,
-        viterbi_radix=viterbi_radix)
+        viterbi_radix=viterbi_radix, sco_track=sco_track)
 
 
 @lru_cache(maxsize=None)
 def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
                     l_cap: int, viterbi_window: int = None,
                     viterbi_metric: str = None,
-                    viterbi_radix: int = None):
+                    viterbi_radix: int = None, profile_key=None,
+                    sco_track: bool = False):
     """ONE compiled loopback link per (lane count, bit bucket, symbol
-    bucket, capture bucket, decode mode): the whole TX → channel → RX
-    chain — including the acquisition classify tree and the batched
-    FCS check — as a single XLA program. The CRC flags are always
-    computed (a ~200-byte masked scan per lane — noise next to the
-    Viterbi), so one compile serves both ``check_fcs`` modes."""
+    bucket, capture bucket, decode mode, per-lane channel-profile
+    names): the whole TX → channel → RX chain — including the
+    acquisition classify tree and the batched FCS check — as a single
+    XLA program. A profiled link is STILL one dispatch: the profile's
+    taps/SCO/drift/bursts trace into the channel stage as per-lane
+    constants (callers pass RESOLVED names — jaxlint R1). The CRC
+    flags are always computed (a ~200-byte masked scan per lane —
+    noise next to the Viterbi), so one compile serves both
+    ``check_fcs`` modes."""
     need_b = rx.FRAME_DATA_START + 80 * sym_bucket
 
     def f(bits_b, nbits_b, ridx_b, nv_tx, snr, eps, dly, seed,
@@ -268,9 +311,11 @@ def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
         samples = tx.encode_many_graph(bits_b, nbits_b, ridx_b,
                                        sym_bucket)
         # 2. per-lane channel impairments (counter fold-in keys:
-        #    lane i's noise is the same fused, staged, or alone)
+        #    lane i's noise is the same fused, staged, or alone —
+        #    profiled lanes included)
         caps = channel.impair_many_graph(samples, nv_tx, snr, eps,
-                                         dly, seed, l_cap)
+                                         dly, seed, l_cap,
+                                         profile_key)
         # 3. batched acquisition: detect / LTS timing / CFO / SIGNAL
         #    (the whole capture is the lane's buffer, so n_valid and
         #    the detector's position cap are both l_cap — exactly what
@@ -296,7 +341,8 @@ def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
         #    SIGNAL only gates validity via `status`)
         clear = rx.decode_data_mixed(segs, ridx_b, ndata_b, sym_bucket,
                                      viterbi_window, viterbi_metric,
-                                     viterbi_radix)
+                                     viterbi_radix,
+                                     sco_track=sco_track)
         # 7. batched FCS check over the decoded PSDUs
         crc_ok = rx.crc_psdu_many_graph(clear, nbits_b)
         return status, mbps_sig, len_sig, nsym_sig, clear, crc_ok
@@ -306,7 +352,8 @@ def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
 
 def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
                     viterbi_window, viterbi_metric,
-                    viterbi_radix=None) -> List:
+                    viterbi_radix=None, prof_rows=None,
+                    sco_track: bool = False) -> List:
     """Host wrapper of the fused graph: ONE device dispatch, then the
     per-lane RxResult assembly from the returned validity flags —
     integer reads only, exactly mirroring `_classify_acquire`'s
@@ -319,7 +366,8 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
     from ziria_tpu.runtime import resilience
 
     fn = _jit_fused_link(geo.rows, geo.bit_b, geo.sym_b, geo.l_cap,
-                         viterbi_window, viterbi_metric, viterbi_radix)
+                         viterbi_window, viterbi_metric, viterbi_radix,
+                         prof_rows, sco_track)
     fused_args = (
         jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
         jnp.asarray(geo.ridx_b), jnp.asarray(geo.nv_tx),
@@ -338,7 +386,8 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
     except resilience.DispatchFailed:
         _note_link_degraded("link.fused_degraded")
         return _loopback_staged(geo, seed, check_fcs, viterbi_window,
-                                viterbi_metric, viterbi_radix)
+                                viterbi_metric, viterbi_radix,
+                                prof_rows, sco_track)
     try:
         # on an async backend a mid-execution runtime failure
         # surfaces HERE at the host pull, after the guarded dispatch
@@ -351,7 +400,8 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
     except Exception:        # noqa: BLE001 - async loss, degrade
         _note_link_degraded("link.fused_degraded")
         return _loopback_staged(geo, seed, check_fcs, viterbi_window,
-                                viterbi_metric, viterbi_radix)
+                                viterbi_metric, viterbi_radix,
+                                prof_rows, sco_track)
     # healthy pass: re-record the gauge LEVEL so a past degrade does
     # not latch forever on dashboards (the rx receivers' per-chunk
     # level discipline)
@@ -379,7 +429,8 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
             # geometry — replay the batch through the oracle
             return _loopback_staged(geo, seed, check_fcs,
                                     viterbi_window, viterbi_metric,
-                                    viterbi_radix)
+                                    viterbi_radix, prof_rows,
+                                    sco_track)
         if clear_np is None:
             try:
                 clear_np = np.asarray(clear, np.uint8)
@@ -388,7 +439,8 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
                 _note_link_degraded("link.fused_degraded")
                 return _loopback_staged(geo, seed, check_fcs,
                                         viterbi_window, viterbi_metric,
-                                        viterbi_radix)
+                                        viterbi_radix, prof_rows,
+                                        sco_track)
         psdu = clear_np[i][N_SERVICE_BITS: N_SERVICE_BITS + 8 * ln]
         crc = bool(crc_np[i]) if check_fcs else None
         results[i] = rx.RxResult(True, m, ln, psdu, crc)
@@ -399,7 +451,8 @@ def stream_many(psdus, rates_mbps: Sequence[int], gaps=None,
                 snr_db=np.inf, cfo: float = 0.0, delay: int = 0,
                 seed: int = 0, add_fcs: bool = False,
                 tail: int = 2048,
-                batched_tx: Optional[bool] = None):
+                batched_tx: Optional[bool] = None,
+                channel_profile=None, _lane: int = 0):
     """Synthesize a continuous multi-frame I/Q stream — the stimulus
     of the streaming receiver (`framebatch.receive_stream`) and its
     bench: N mixed-rate frames at random (or given) inter-frame gaps,
@@ -418,8 +471,19 @@ def stream_many(psdus, rates_mbps: Sequence[int], gaps=None,
     long preamble (per-capture `receive`'s global LTS peak-pick could
     otherwise time onto the stronger neighbor; identity would hold,
     per-frame decode would not). `tail` idle samples close the stream
-    so the last frame's window is full-length."""
+    so the last frame's window is full-length.
+
+    ``channel_profile`` (a profile name or None -> the
+    ``ZIRIA_CHANNEL_PROFILE`` default; flat IS the unprofiled stream)
+    applies the profile's multipath/SCO/drift/bursts over the WHOLE
+    stream via `channel.impair_stream` — the streaming fleet's
+    physical-fault campaign stimulus. Under an ``sco`` profile the
+    returned `starts` are the PRE-resample positions (true positions
+    drift by up to ``sco * len(stream)`` samples — slice-at-truth
+    identity contracts should use flat-tap profiles)."""
     n = len(psdus)
+    prof_names = chanprof.resolve_profiles(channel_profile, 1)
+    prof_name = None if prof_names is None else prof_names[0]
     if len(rates_mbps) != n:
         raise ValueError(f"{n} PSDUs but {len(rates_mbps)} rates")
     if n == 0:
@@ -456,7 +520,8 @@ def stream_many(psdus, rates_mbps: Sequence[int], gaps=None,
     for s, f in zip(starts, frames):
         stream[s: s + f.shape[0]] = f
         n_signal += f.shape[0]
-    return (channel.impair_stream(stream, n_signal, snr_db, cfo, seed),
+    return (channel.impair_stream(stream, n_signal, snr_db, cfo, seed,
+                                  profile=prof_name, lane=_lane),
             starts)
 
 
@@ -516,7 +581,8 @@ def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
                       cfo=0.0, delay=0, seed: int = 0,
                       add_fcs: bool = False, tail: int = 2048,
                       gaps=None, batched_tx: Optional[bool] = None,
-                      arrival: Optional[ArrivalSpec] = None):
+                      arrival: Optional[ArrivalSpec] = None,
+                      channel_profile=None):
     """The S-stream load synthesizer — the stimulus of the multi-
     stream receiver (`framebatch.receive_streams`) and its bench:
     stream i is exactly ``stream_many(psdus_per_stream[i],
@@ -540,7 +606,12 @@ def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
     slabs concatenate back to the stream exactly, so pushing a
     schedule through a receiver emits bit-identically to pushing the
     whole stream. Default ``None`` keeps the two-element return —
-    existing call sites unchanged."""
+    existing call sites unchanged.
+
+    ``channel_profile`` is a name or per-STREAM sequence (cycling, the
+    `profiles.resolve_profiles` rule; None -> the env default): each
+    stream rides its own physical channel — the fleet-scale
+    physical-fault campaign stimulus of the soak harness."""
     s = len(psdus_per_stream)
     if len(rates_per_stream) != s:
         raise ValueError(f"{s} streams of PSDUs but "
@@ -548,6 +619,7 @@ def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
     if gaps is not None and len(gaps) != s:
         raise ValueError(f"{s} streams need {s} gap sequences, "
                          f"got {len(gaps)}")
+    prof_key = chanprof.resolve_profiles(channel_profile, s)
     snr = _lane_param(snr_db, s, np.float64)
     eps = _lane_param(cfo, s, np.float64)
     dly = _lane_param(delay, s, np.int64)
@@ -558,7 +630,12 @@ def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
             gaps=None if gaps is None else gaps[i],
             snr_db=float(snr[i]), cfo=float(eps[i]),
             delay=int(dly[i]), seed=_stream_seed(seed, i),
-            add_fcs=add_fcs, tail=tail, batched_tx=batched_tx)
+            add_fcs=add_fcs, tail=tail, batched_tx=batched_tx,
+            # "flat" (not None) when the fleet resolved to no profile:
+            # the per-stream call must not resurrect the env default
+            # the fleet-level resolution already consumed
+            channel_profile=("flat" if prof_key is None
+                             else prof_key[i]))
         streams.append(st)
         starts.append(sts)
     if arrival is None:
@@ -570,7 +647,9 @@ def stream_many_multi(psdus_per_stream, rates_per_stream, snr_db=np.inf,
 
 
 def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
-                      batched_tx: Optional[bool] = None) -> np.ndarray:
+                      batched_tx: Optional[bool] = None,
+                      profile=None,
+                      sco_track: Optional[bool] = None) -> np.ndarray:
     """Perfect-sync single-rate BER loopback — the statistical lane of
     the link (BER waterfalls measure the equalize/demap/Viterbi chain,
     not packet detection): (B, n_bytes) PSDUs encode in ONE dispatch
@@ -578,12 +657,20 @@ def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
     is off — bit-identical), AWGN rides one vmapped dispatch with
     per-lane split keys, and the batched DATA decode returns the
     decoded PSDU bits (B, 8*n_bytes). `sweep_ber` is the ONE-dispatch
-    sweep of exactly this step over a (SNR x seed) grid — equal error
-    counts point for point."""
+    sweep of exactly this step over a (SNR x seed x profile) grid —
+    equal error counts point for point.
+
+    ``profile`` (one name; None/"flat" = today's AWGN path, exactly)
+    routes the batch through `channel.impair_profile_point_graph` —
+    multipath/SCO/drift before the SAME awgn expression at the SAME
+    split keys, seeded bursts after — so the profiled sweep's loop
+    twin stays integer-identical. ``sco_track`` is the RX knob."""
     psdus = np.asarray(psdus, np.uint8)
     rate = RATES[rate_mbps]
     n_bytes = psdus.shape[1]
     n_sym = n_symbols(n_bytes, rate)
+    names = chanprof.resolve_profiles(profile, 1, use_env=False)
+    sco_track = rx.sco_track_enabled(sco_track)
     if batched_tx_enabled(batched_tx):
         frames = tx.encode_batch(psdus, rate_mbps)
     else:
@@ -591,10 +678,15 @@ def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
                             for p in psdus])
     keys = jax.random.split(jax.random.PRNGKey(seed), psdus.shape[0])
     with dispatch.timed("channel.awgn_batch"):
-        noisy = jax.vmap(
-            lambda k, f: channel.awgn(k, f, snr_db))(keys, frames)
+        if names is None:
+            noisy = jax.vmap(
+                lambda k, f: channel.awgn(k, f, snr_db))(keys, frames)
+        else:
+            noisy = channel.impair_profile_point_graph(
+                frames, keys, snr_db, names[0])
     with dispatch.timed("rx.decode_batch"):
-        got, _ = rx.decode_data_batch(noisy, rate, n_sym, 8 * n_bytes)
+        got, _ = rx.decode_data_batch(noisy, rate, n_sym, 8 * n_bytes,
+                                      sco_track=sco_track)
     return np.asarray(got)
 
 
@@ -609,33 +701,52 @@ def loopback_ber_bits(psdus, rate_mbps: int, snr_db: float, seed: int,
 # integer-for-integer with a python loop of batches.
 
 
-def _sweep_point_graph(frames_by_rate, want_bits, rate_list, snr, seed):
+def _sweep_point_graph(frames_by_rate, want_bits, rate_list, snr, seed,
+                       profiles_key=None, sco_track: bool = False):
     """One sweep point, traced: AWGN at `snr` with keys split from
     `seed` (the SAME key schedule as loopback_ber_bits — lane i's
     noise never depends on which rates ride the sweep), the batched
     DATA decode per rate, and integer error counts vs the known TX
-    bits. Returns (n_rates,) int32."""
+    bits. Returns (n_rates,) int32 — or, with ``profiles_key`` (a
+    tuple of profile names), (n_profiles * n_rates,) profile-major:
+    each profile column applies its taps/SCO/drift before the SAME
+    awgn expression at the SAME keys and its bursts after
+    (`channel.impair_profile_point_graph`), while a ``flat`` column
+    skips the profile ops entirely — it IS the unprofiled expression,
+    so its counts are bit-identical to the profile-less sweep."""
     errs = []
-    for frames, (m, n_sym, n_psdu_bits) in zip(frames_by_rate,
-                                               rate_list):
-        keys = jax.random.split(jax.random.PRNGKey(seed),
-                                frames.shape[0])
-        noisy = jax.vmap(
-            lambda k, f, _s=snr: channel.awgn(k, f, _s))(keys, frames)
-        got, _ = rx.decode_data_batch(noisy, RATES[m], n_sym,
-                                      n_psdu_bits)
-        errs.append(jnp.sum(got != want_bits, dtype=jnp.int32))
+    for pname in (profiles_key or (None,)):
+        prof = None if pname is None else chanprof.get_profile(pname)
+        for frames, (m, n_sym, n_psdu_bits) in zip(frames_by_rate,
+                                                   rate_list):
+            keys = jax.random.split(jax.random.PRNGKey(seed),
+                                    frames.shape[0])
+            if prof is None or prof.is_flat:
+                noisy = jax.vmap(
+                    lambda k, f, _s=snr: channel.awgn(k, f, _s))(
+                        keys, frames)
+            else:
+                noisy = channel.impair_profile_point_graph(
+                    frames, keys, snr, prof.name)
+            got, _ = rx.decode_data_batch(noisy, RATES[m], n_sym,
+                                          n_psdu_bits,
+                                          sco_track=sco_track)
+            errs.append(jnp.sum(got != want_bits, dtype=jnp.int32))
     return jnp.stack(errs)
 
 
 @lru_cache(maxsize=None)
-def _jit_sweep_ber(rates_key: tuple, n_bytes: int, donate: bool):
-    """ONE compiled sweep per (rate tuple, frame bytes): encode every
-    rate's frame batch once (scan-invariant — XLA hoists it), then
-    `lax.scan` the point step over the (snr, seed) grid, writing each
-    point's error counts into the carried buffer. The buffer is
-    DONATED (where the backend supports donation), so repeated sweeps
-    reuse its pages instead of allocating per call."""
+def _jit_sweep_ber(rates_key: tuple, n_bytes: int, donate: bool,
+                   profiles_key=None, sco_track: bool = False):
+    """ONE compiled sweep per (rate tuple, frame bytes, profile
+    tuple, sco_track): encode every rate's frame batch once
+    (scan-invariant — XLA hoists it), then `lax.scan` the point step
+    over the (snr, seed) grid, writing each point's error counts —
+    (n_profiles x n_rates) wide under a profile axis — into the
+    carried buffer. STILL one dispatch for the whole rates x SNR x
+    profile waterfall. The buffer is DONATED (where the backend
+    supports donation), so repeated sweeps reuse its pages instead of
+    allocating per call."""
     rate_list = tuple(
         (m, n_symbols(n_bytes, RATES[m]), 8 * n_bytes)
         for m in rates_key)
@@ -657,7 +768,8 @@ def _jit_sweep_ber(rates_key: tuple, n_bytes: int, donate: bool):
             i, buf = carry
             snr, seed = xs
             e = _sweep_point_graph(frames_by_rate, bits_b,
-                                   rate_list, snr, seed)
+                                   rate_list, snr, seed,
+                                   profiles_key, sco_track)
             buf = jax.lax.dynamic_update_slice(
                 buf, e[None], (i, jnp.int32(0)))
             return (i + 1, buf), None
@@ -682,6 +794,8 @@ def _sweep_dispatch(sweep_fn, bits_d, snr_d, seed_d, n_points: int,
 
 def sweep_ber(psdus, rates_mbps: Sequence[int],
               snr_grid: Sequence[float], seeds: Sequence[int],
+              profiles: Optional[Sequence] = None,
+              sco_track: Optional[bool] = None,
               _shard=None) -> np.ndarray:
     """An entire BER waterfall in ONE device dispatch: every rate in
     `rates_mbps` over every (snr, seed) point of the grid, via one
@@ -693,6 +807,17 @@ def sweep_ber(psdus, rates_mbps: Sequence[int],
     round trips per point through that loop and ~5 per point through
     the staged full link.
 
+    ``profiles`` (a sequence of channel-profile names) grows the
+    waterfall a PROFILE axis — rates x profiles x SNR x seeds, STILL
+    one `lax.scan` dispatch — returning (len(rates), len(profiles),
+    len(snr_grid), len(seeds)); the ``"flat"`` column's counts are
+    bit-identical to the profile-less sweep by construction (it IS
+    the unprofiled expression — tests/test_channel_profiles.py), and
+    hostile columns gate the BER envelopes the channel_sweep bench
+    stage records. ``sco_track`` opts every column's decode into the
+    pilot phase-ramp tracking (one more cache-key bit). None keeps
+    today's 3-axis return exactly.
+
     `_shard` (internal — `sweep_ber_sharded` passes it) is a callable
     placing the lane-axis arrays on a device mesh before the call."""
     psdus = np.asarray(psdus, np.uint8)
@@ -700,6 +825,17 @@ def sweep_ber(psdus, rates_mbps: Sequence[int],
         raise ValueError("psdus must be (B, n_bytes)")
     b, n_bytes = psdus.shape
     rates_key = tuple(int(m) for m in rates_mbps)
+    profiles_key = None if profiles is None else tuple(
+        chanprof.get_profile(p).name for p in profiles)
+    if profiles_key == ():
+        # a zero-width profile axis would compile a zero-column error
+        # buffer and die deep in the reshape — a caller bug, not a
+        # backend fault, so fail HERE with the fix in the message
+        raise ValueError("profiles must be a non-empty sequence of "
+                         "profile names, or None for the unprofiled "
+                         "3-axis sweep")
+    n_prof = 1 if profiles_key is None else len(profiles_key)
+    sco_track = rx.sco_track_enabled(sco_track)
     bits = np.stack([tx._host_psdu_bits(p, False) for p in psdus])
     snrs = np.asarray(snr_grid, np.float32)
     seed_arr = np.asarray(seeds, np.int32)
@@ -710,16 +846,25 @@ def sweep_ber(psdus, rates_mbps: Sequence[int],
     # shape/dtype witness for note_site only (the REAL donated carry
     # is allocated fresh per attempt inside _sweep_dispatch): a host
     # array carries the aval without a wasted device allocation
-    errbuf = np.zeros((n_points, len(rates_key)), np.int32)
+    errbuf = np.zeros((n_points, n_prof * len(rates_key)), np.int32)
     bits_d = jnp.asarray(bits)
     if _shard is not None:
         bits_d = _shard(bits_d)
     donate = jax.devices()[0].platform != "cpu"   # no-op (+warn) on CPU
-    sweep_fn = _jit_sweep_ber(rates_key, n_bytes, donate)
+    sweep_fn = _jit_sweep_ber(rates_key, n_bytes, donate,
+                              profiles_key, sco_track)
     snr_d = jnp.asarray(snr_flat)
     seed_d = jnp.asarray(seed_flat)
     programs.note_site("link.sweep", sweep_fn, bits_d, snr_d, seed_d,
                        errbuf)
+
+    def _shape(errs):
+        # (points, P*R) profile-major -> (R, S, K) or (R, P, S, K)
+        errs = errs.reshape(snrs.shape[0], seed_arr.shape[0], n_prof,
+                            len(rates_key))
+        out = np.transpose(errs, (3, 2, 0, 1))
+        return out[:, 0] if profiles_key is None else out
+
     from ziria_tpu.runtime import resilience
     try:
         # guarded (runtime/resilience): transient failures retry to
@@ -731,11 +876,12 @@ def sweep_ber(psdus, rates_mbps: Sequence[int],
         # must not re-pass a donated (hence deleted) buffer
         out = resilience.guarded(
             "link.sweep", _sweep_dispatch, sweep_fn, bits_d, snr_d,
-            seed_d, n_points, len(rates_key))
+            seed_d, n_points, n_prof * len(rates_key))
     except resilience.DispatchFailed:
         _note_link_degraded("link.sweep_degraded")
-        return _sweep_ber_loop(psdus, rates_key, snr_flat, seed_flat,
-                               bits, snrs.shape[0], seed_arr.shape[0])
+        return _shape(_sweep_ber_loop(psdus, rates_key, snr_flat,
+                                      seed_flat, bits, profiles_key,
+                                      sco_track))
     # host pull outside the timed block (jaxlint R2): the site times
     # the dispatch, not the device wait. On an async backend a
     # mid-execution failure surfaces at THIS pull — one guarded
@@ -746,39 +892,47 @@ def sweep_ber(psdus, rates_mbps: Sequence[int],
         try:
             out = resilience.guarded(
                 "link.sweep", _sweep_dispatch, sweep_fn, bits_d,
-                snr_d, seed_d, n_points, len(rates_key))
+                snr_d, seed_d, n_points, n_prof * len(rates_key))
             errs = np.asarray(out, np.int64)
         except Exception:        # noqa: BLE001 - degrade to the loop
             _note_link_degraded("link.sweep_degraded")
-            return _sweep_ber_loop(psdus, rates_key, snr_flat,
-                                   seed_flat, bits, snrs.shape[0],
-                                   seed_arr.shape[0])
+            return _shape(_sweep_ber_loop(psdus, rates_key, snr_flat,
+                                          seed_flat, bits,
+                                          profiles_key, sco_track))
     dispatch.record_gauge("link.degraded_mode", 0.0)   # healthy pass
-    return np.transpose(
-        errs.reshape(snrs.shape[0], seed_arr.shape[0],
-                     len(rates_key)), (2, 0, 1))
+    return _shape(errs)
 
 
 def _sweep_ber_loop(psdus, rates_key, snr_flat, seed_flat, bits,
-                    n_snrs: int, n_seeds: int) -> np.ndarray:
+                    profiles_key=None,
+                    sco_track: bool = False) -> np.ndarray:
     """The sweep's degraded twin: the python loop of per-batch
-    `loopback_ber_bits` steps over the same (snr, seed) points — the
-    exact loop `sweep_ber` is pinned integer-identical against. ~3
+    `loopback_ber_bits` steps over the same (snr, seed[, profile])
+    points — the exact loop `sweep_ber` is pinned integer-identical
+    against (loopback_ber_bits applies a point's profile through the
+    SAME `impair_profile_point_graph` at the SAME split keys). ~3
     host round trips per point instead of one total, but counts are
-    bit-identical; used only when the compiled sweep fails for good."""
+    bit-identical; used only when the compiled sweep fails for good.
+    Returns flat (points, n_prof * n_rates) counts, profile-major —
+    the caller owns the waterfall reshape."""
     n_rates = len(rates_key)
-    errs = np.zeros((len(snr_flat), n_rates), np.int64)
+    profs = profiles_key or (None,)
+    errs = np.zeros((len(snr_flat), len(profs) * n_rates), np.int64)
     for p, (snr, seed) in enumerate(zip(snr_flat, seed_flat)):
-        for r, m in enumerate(rates_key):
-            got = loopback_ber_bits(psdus, m, float(snr), int(seed))
-            errs[p, r] = int((got != bits).sum())
-    return np.transpose(
-        errs.reshape(n_snrs, n_seeds, n_rates), (2, 0, 1))
+        for pi, pname in enumerate(profs):
+            for r, m in enumerate(rates_key):
+                got = loopback_ber_bits(psdus, m, float(snr),
+                                        int(seed), profile=pname,
+                                        sco_track=sco_track)
+                errs[p, pi * n_rates + r] = int((got != bits).sum())
+    return errs
 
 
 def sweep_ber_sharded(psdus, rates_mbps: Sequence[int],
                       snr_grid: Sequence[float], seeds: Sequence[int],
-                      mesh=None, axis: str = "dp") -> np.ndarray:
+                      mesh=None, axis: str = "dp",
+                      profiles: Optional[Sequence] = None,
+                      sco_track: Optional[bool] = None) -> np.ndarray:
     """`sweep_ber` with the frame-lane axis sharded over a device mesh
     (`parallel/batch.frame_mesh()` by default — every visible chip):
     each device encodes/impairs/decodes its shard of lanes, XLA
@@ -788,10 +942,12 @@ def sweep_ber_sharded(psdus, rates_mbps: Sequence[int],
     batch must divide the mesh (`shard_batch`'s rule). The MULTICHIP
     dryrun (`__graft_entry__.dryrun_multichip`) pins the multi-device
     path; `parallel/batch.data_parallel` is the same placement pattern
-    this reuses."""
+    this reuses. The profile axis shards with it (per-lane profile
+    ops are lane-local — no new collectives)."""
     from ziria_tpu.parallel import batch as pbatch
 
     if mesh is None:
         mesh = pbatch.frame_mesh()
     return sweep_ber(psdus, rates_mbps, snr_grid, seeds,
+                     profiles=profiles, sco_track=sco_track,
                      _shard=lambda x: pbatch.shard_batch(mesh, x, axis))

@@ -257,6 +257,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "guarded dispatch: watchdog + exponential "
                         "backoff with deterministic jitter; default "
                         "2). Also via ZIRIA_MAX_RETRIES")
+    p.add_argument("--channel-profile", metavar="NAME[,NAME...]",
+                   help="default physical-channel profile of the "
+                        "stimulus surfaces (phy/profiles; "
+                        "docs/robustness.md): named multipath / "
+                        "sampling-clock-offset / Doppler-drift / "
+                        "interference-burst parameter sets — flat, "
+                        "mild, urban, severe, sco, doppler, bursty, "
+                        "hostile — applied as vmapped per-lane taps "
+                        "inside the existing channel dispatches "
+                        "('flat' IS the unprofiled channel, bit-"
+                        "identical by construction; a comma list "
+                        "assigns per lane/stream, cycling). Also via "
+                        "ZIRIA_CHANNEL_PROFILE")
+    p.add_argument("--rx-sco-track", dest="rx_sco_track",
+                   action="store_true", default=None,
+                   help="pilot phase-RAMP tracking in the RX DATA "
+                        "decode (the sampling-clock-offset hardening; "
+                        "docs/robustness.md). Default off — the flat-"
+                        "channel decode is pinned bit-identical and "
+                        "a fitted slope is never exactly zero. Also "
+                        "via ZIRIA_RX_SCO_TRACK=1")
+    p.add_argument("--no-rx-sco-track", dest="rx_sco_track",
+                   action="store_false",
+                   help="force SCO tracking off (overrides an "
+                        "exported ZIRIA_RX_SCO_TRACK=1)")
     p.add_argument("--state-in",
                    help="resume stream state from this checkpoint "
                         "(runtime/state.py; jit backend)")
@@ -826,6 +851,23 @@ def main(argv=None) -> int:
             raise SystemExit(
                 f"--max-retries: {args.max_retries} must be >= 0")
         overrides["ZIRIA_MAX_RETRIES"] = str(args.max_retries)
+    if args.channel_profile is not None:
+        # profiles.env_channel_profile reads this at the stimulus
+        # surfaces (link.stream_many[_multi], loopback_many). Validate
+        # NOW so an unknown profile is a flag error naming the known
+        # registry, not a traceback from deep inside the run
+        from ziria_tpu.phy import profiles as _profiles
+        try:
+            _profiles.parse_profile_spec(args.channel_profile)
+        except ValueError as e:
+            raise SystemExit(f"--channel-profile: {e}")
+        overrides["ZIRIA_CHANNEL_PROFILE"] = args.channel_profile
+    if args.rx_sco_track is not None:
+        # rx.sco_track_enabled reads this at decode-surface entry
+        # (resolved once, part of every decode factory's cache key);
+        # --no-rx-sco-track force-disables an exported env value
+        overrides["ZIRIA_RX_SCO_TRACK"] = \
+            "1" if args.rx_sco_track else "0"
     if args.trace:
         # telemetry.env_trace_path reads this inside _main_run; the
         # scoped write keeps in-process callers from inheriting an

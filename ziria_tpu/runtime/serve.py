@@ -1077,7 +1077,8 @@ def synth_load(n_sessions: int, frames_per_session: int = 3,
                seed: int = 0, add_fcs: bool = True,
                tail: int = 1024, arrival=None,
                misbehave: Optional[Dict[int, str]] = None,
-               slo_s: Optional[float] = None) -> List[ClientSpec]:
+               slo_s: Optional[float] = None,
+               channel_profile=None) -> List[ClientSpec]:
     """The many-client load generator (built on
     `link.stream_many_multi`'s arrival schedules): ``n_sessions``
     independent mixed-rate streams cut into seeded ragged slab
@@ -1101,9 +1102,15 @@ def synth_load(n_sessions: int, frames_per_session: int = 3,
         rates_per.append(rates)
         psdus_per.append([rng.integers(0, 256, n_bytes)
                           .astype(np.uint8) for _ in rates])
+    # channel_profile (name / per-stream list / None -> the
+    # ZIRIA_CHANNEL_PROFILE default) rides stream_many_multi's
+    # per-stream physical channel: the serving load generator can
+    # campaign multipath/SCO/Doppler/burst clients alongside the
+    # misbehave modes (the soak harness's multipath-active rounds)
     streams, _starts, schedules = link.stream_many_multi(
         psdus_per, rates_per, snr_db=snr_db, cfo=1e-4, delay=60,
-        seed=seed, add_fcs=add_fcs, tail=tail, arrival=arrival)
+        seed=seed, add_fcs=add_fcs, tail=tail, arrival=arrival,
+        channel_profile=channel_profile)
 
     out = []
     for i in range(n_sessions):
@@ -1252,6 +1259,12 @@ def main(argv=None) -> int:
                         "(quarantine demo)")
     p.add_argument("--chaos", metavar="SPEC", default=None,
                    help="fault-injection spec (utils/faults grammar)")
+    p.add_argument("--channel-profile", metavar="NAME[,NAME...]",
+                   default=None,
+                   help="physical-channel profile(s) for the client "
+                        "load (phy/profiles; comma lists cycle per "
+                        "session — the multipath/SCO/Doppler/burst "
+                        "campaign stimulus, docs/robustness.md)")
     p.add_argument("--metrics-dump", action="store_true",
                    help="print the Prometheus exposition to stderr "
                         "at exit")
@@ -1277,8 +1290,15 @@ def main(argv=None) -> int:
                       snapshot_dir=args.snapshot_dir,
                       snapshot_every=args.snapshot_every)
     misbehave = {0: "nan"} if args.nan_client else {}
+    if args.channel_profile is not None:
+        from ziria_tpu.phy.profiles import parse_profile_spec
+        try:
+            parse_profile_spec(args.channel_profile)
+        except ValueError as e:
+            raise SystemExit(f"--channel-profile: {e}")
     clients = synth_load(args.sessions, args.frames, seed=args.seed,
-                         misbehave=misbehave, tail=args.frame_len)
+                         misbehave=misbehave, tail=args.frame_len,
+                         channel_profile=args.channel_profile)
     chaos = None
     if args.chaos is not None:
         try:

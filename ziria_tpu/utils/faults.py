@@ -53,8 +53,11 @@ R4). Spec grammar, semicolon-separated::
 with keys ``every=N`` (fire every Nth call), ``calls=i+j+k`` (explicit
 0-based call indices), ``p=F`` (probability, hashed from
 (site, seed, index)), ``count=N`` (max firings), ``delay=F`` (seconds,
-for delay/hang), ``frac=F`` (slab fraction, for nan_slab/truncate).
-Example: ``ZIRIA_CHAOS="seed=3;rx.stream_chunk:transient:every=7"``.
+for delay/hang), ``frac=F`` (slab fraction, for nan_slab/truncate),
+``profile=NAME`` (a phy/profiles channel-profile name, for the
+``channel`` kind — default ``hostile``).
+Examples: ``ZIRIA_CHAOS="seed=3;rx.stream_chunk:transient:every=7"``,
+``ZIRIA_CHAOS="rx.push.s*:channel:profile=severe,every=2"``.
 """
 
 from __future__ import annotations
@@ -73,11 +76,20 @@ _PLANS: Tuple["FaultPlan", ...] = ()
 
 #: the injectable fault classes (docs/robustness.md taxonomy)
 KINDS = ("nan_slab", "truncate", "transient", "fatal", "delay", "hang",
-         "io_torn", "io_enospc")
+         "io_torn", "io_enospc", "channel")
 
 #: kinds that act at data (push) seams vs dispatch seams vs the
-#: durability write seams (journal append / snapshot file writes)
-DATA_KINDS = ("nan_slab", "truncate")
+#: durability write seams (journal append / snapshot file writes).
+#: ``channel`` is a data kind: it passes the slab through a named
+#: physical-channel profile (phy/profiles — multipath FIR, SCO
+#: resample, drift phase, interference bursts) in PURE NUMPY, so the
+#: chaos layer stays jax-free (tools/chaos_smoke.py's no-jax pin).
+#: Applied per-slab it is a chaos corruption, not stream physics —
+#: frames straddling slab boundaries see filter seams, exactly the
+#: kind of hostile input the quarantine/CRC machinery must absorb
+#: without crashing; the physically-continuous stimulus lives in
+#: link.stream_many(channel_profile=...).
+DATA_KINDS = ("nan_slab", "truncate", "channel")
 DISPATCH_KINDS = ("transient", "fatal", "delay", "hang")
 IO_KINDS = ("io_torn", "io_enospc")
 
@@ -115,6 +127,10 @@ class FaultSpec(NamedTuple):
     count: int = 0
     delay_s: float = 0.01
     fraction: float = 0.25
+    #: channel-profile name for the ``channel`` kind (grammar key
+    #: ``profile=NAME``; default ``hostile`` — validated against
+    #: phy/profiles.CHANNEL_PROFILES at plan construction)
+    profile: str = "hostile"
 
 
 def _unit(site: str, seed: int, idx: int) -> float:
@@ -140,6 +156,12 @@ class FaultPlan:
                 raise ValueError(
                     f"spec {sp.site}:{sp.kind} needs exactly one of "
                     f"calls=/every=/p= to select its firing calls")
+            if sp.kind == "channel":
+                # jax-free import (phy/profiles is plain data) —
+                # unknown profile names fail at plan construction
+                # with the registry's own known-names message
+                from ziria_tpu.phy.profiles import get_profile
+                get_profile(sp.profile)
         self.specs = specs
         self.seed = int(seed)
         self._lock = threading.Lock()
@@ -245,14 +267,47 @@ def maybe_fail(site: str) -> None:
                 f"(call {idx})")
 
 
+def _channel_slab(arr: np.ndarray, profile: str, seed: int,
+                  idx: int) -> np.ndarray:
+    """The ``channel`` data kind: pass a slab through a named
+    physical-channel profile in pure numpy — multipath FIR + SCO
+    resample (the jax-free host twins in phy/profiles), a drift phase
+    ramp from the slab's own origin, and seeded interference bursts
+    (numpy RNG keyed by the plan's (site, seed, call-index) hash, so
+    every replay corrupts identically). Per-slab application is a
+    deterministic hostile-input FAULT (boundary seams included), not
+    continuous stream physics."""
+    from ziria_tpu.phy.profiles import get_profile, np_apply_drift, \
+        np_apply_sco, np_apply_taps, np_burst_amp, np_burst_mask
+
+    prof = get_profile(profile)
+    x = np_apply_taps(np.asarray(arr, np.float32), prof)
+    x = np_apply_sco(x, prof.sco)
+    x = np_apply_drift(x, prof.drift)
+    n = x.shape[0]
+    if prof.burst_every and n:
+        rs = np.random.default_rng(int(_unit(f"chan:{profile}", seed,
+                                             idx) * (1 << 53)))
+        off = int(rs.integers(0, prof.burst_every))
+        in_burst = np_burst_mask(n, prof, off)
+        p_sig = float(np.mean(np.square(x.astype(np.float64)))) * 2.0
+        amp = np_burst_amp(p_sig, prof)
+        x = (x + rs.normal(size=x.shape)
+             * (amp * in_burst.astype(np.float64))[:, None]) \
+            .astype(np.float32)
+    return x
+
+
 def corrupt_slab(site: str, arr: np.ndarray):
     """The data seam: called on an incoming (n, 2) sample slab at the
     push surfaces. A matching ``nan_slab`` spec NaN-poisons a
     deterministic ``fraction`` of the rows (row choice seeded by
     (site, seed, call-index)); ``truncate`` drops the tail
-    ``fraction``. Returns ``(slab, kinds)`` — the (possibly copied)
-    slab and the tuple of injected kinds (empty when nothing fired).
-    Free when no plan is active."""
+    ``fraction``; ``channel`` passes the slab through its named
+    physical-channel profile (`_channel_slab` — multipath/SCO/drift/
+    bursts, pure numpy). Returns ``(slab, kinds)`` — the (possibly
+    copied) slab and the tuple of injected kinds (empty when nothing
+    fired). Free when no plan is active."""
     if not _PLANS:
         return arr, ()
     kinds: List[str] = []
@@ -272,6 +327,8 @@ def corrupt_slab(site: str, arr: np.ndarray):
         elif sp.kind == "truncate" and n > 1:
             keep = max(1, n - max(1, int(n * sp.fraction)))
             arr = arr[:keep]
+        elif sp.kind == "channel" and n:
+            arr = _channel_slab(arr, sp.profile, plan.seed, idx)
         kinds.append(sp.kind)
     return arr, tuple(kinds)
 
@@ -344,6 +401,8 @@ def parse_chaos_spec(text: str) -> Tuple[Tuple[FaultSpec, ...], int]:
                 kw["delay_s"] = float(v)
             elif k == "frac":
                 kw["fraction"] = float(v)
+            elif k == "profile":
+                kw["profile"] = v
             else:
                 raise ValueError(f"unknown chaos option {k!r}")
         if not (kw.get("calls") or kw.get("every") or kw.get("p")):
