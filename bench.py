@@ -102,6 +102,7 @@ STAGE_METRICS = {
     "resilience": ("faults_recovered", "higher"),
     "serving": ("sps_serving", "higher"),
     "soak": ("recovery_p99_s", "lower"),
+    "autotune": ("sps_tuned", "higher"),
     "lint": ("findings_total", "lower"),
     "programs": ("programs_analyzed", "higher"),
     "numpy_baseline": ("sps", "higher"),
@@ -311,10 +312,13 @@ def _traj_path():
 
 def _traj_append(stage, metric, value, run_id, platform,
                  direction="higher", partial=False, resumed=False,
-                 unit=None, source="bench", t=None):
+                 unit=None, source="bench", t=None, extra=None):
     """Append ONE normalized flat record to the perf-ledger trajectory
     (BENCH_TRAJECTORY.jsonl) — the canonical machine-readable form the
-    BENCH_r*.json "tail" wrapper never was. Best-effort: an unwritable
+    BENCH_r*.json "tail" wrapper never was. ``extra`` carries
+    stage-specific rider fields (the autotune stage's device_kind +
+    winning geometry, which Geometry.tuned() and perf_report's
+    device_kind matching read back). Best-effort: an unwritable
     ledger never blocks a bench run."""
     rec = {"run_id": run_id, "unix": round(
                time.time() if t is None else t, 1),
@@ -325,6 +329,8 @@ def _traj_append(stage, metric, value, run_id, platform,
         rec["resumed"] = True
     if unit:
         rec["unit"] = unit
+    if extra:
+        rec.update(extra)
     try:
         with open(_traj_path(), "a") as f:
             f.write(json.dumps(rec) + "\n")
@@ -349,10 +355,17 @@ def _traj_from_stage(run_id, stage, rec):
     # that aliasing would fake a 2-4x "regression" in the gate
     if stage == "batch_sweep" and rec.get("batch") is not None:
         stage = f"batch_sweep:{rec['batch']}"
+    # the autotune stage's winner rides the ledger record so
+    # Geometry.tuned(device_kind) can reconstruct it later, and so
+    # perf_report's device_kind matching scopes the gate correctly
+    extra = None
+    if stage == "autotune":
+        extra = {k: rec[k] for k in ("device_kind", "geometry")
+                 if k in rec}
     _traj_append(stage, key, v, run_id, rec.get("platform"),
                  direction=direction,
                  resumed=bool(rec.get("resumed_from")),
-                 t=rec.get("t"))
+                 t=rec.get("t"), extra=extra)
 
 
 def _partial(run_id, stage, **kv):
@@ -1698,6 +1711,45 @@ def _child_main(run_id):
             note(f"soak stage failed: {e!r}")
             soak_ev = {"error": repr(e)}
 
+    # ISSUE 16 tentpole evidence: the geometry autotuner
+    # (utils/autotune) — candidates around the default Geometry,
+    # cost-pruned through the PR 9 observatory's analytical model,
+    # survivors measured on the streaming + fused-link surfaces under
+    # the identity gates, best-vs-default speedup recorded. The ledger
+    # record (sps_tuned, higher = better) rides this stage's part()
+    # with device_kind + winning geometry attached, so
+    # Geometry.tuned() reconstructs it. Same resumable never-fatal
+    # stage discipline.
+    def _autotune_stage():
+        if time.time() - t0 > 0.90 * budget:
+            raise TimeoutError("skipped: child time budget")
+        cpu = os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+        from ziria_tpu.utils import autotune as at
+        ev_full = at.run(n_frames=4 if cpu else 12,
+                         n_bytes=16 if cpu else 50,
+                         reps=1 if cpu else 3,
+                         record=False, log=note)
+        ev = {k: ev_full[k] for k in (
+            "winner", "geometry", "sps_tuned", "baseline_sps",
+            "speedup", "device_kind", "platform", "candidates",
+            "pruned", "identity_rejected", "measured")}
+        note(f"autotune: winner '{ev['winner']}' "
+             f"{ev['sps_tuned']:.0f} sps ({ev['speedup']}x default), "
+             f"{len(ev['pruned'])} cost-pruned, "
+             f"{len(ev['identity_rejected'])} identity-rejected")
+        part("autotune", **ev)
+        return ev
+
+    if "autotune" in resume:
+        tune_ev = reuse(resume["autotune"])
+        note("autotune resumed from prior window")
+    else:
+        try:
+            tune_ev = _autotune_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"autotune stage failed: {e!r}")
+            tune_ev = {"error": repr(e)}
+
     # ISSUE 8 tentpole evidence: the jaxlint static-analysis sweep —
     # per-rule finding counts (and the suppression count) over
     # ziria_tpu/, recorded in the artifact so the trend — and any
@@ -1846,6 +1898,7 @@ def _child_main(run_id):
         "resilience": res_ev,
         "serving": serving_ev,
         "soak": soak_ev,
+        "autotune": tune_ev,
         "lint": lint_ev,
         "programs": prog_ev,
         "roofline": _roofline(

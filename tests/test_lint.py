@@ -368,6 +368,86 @@ def test_r5_scalar_keys_clean():
     assert _findings(R5_NEGATIVE, rules=["R5"]) == []
 
 
+# ------------------------------------------------------------------ R6
+
+R6_TP_BUCKET_FLOOR = '''
+from ziria_tpu.utils.dispatch import pow2_bucket
+
+def n_sym_bucket(n_sym):
+    return pow2_bucket(n_sym, 4)         # literal floor forks Geometry
+'''
+
+R6_TP_BUCKET_KW = '''
+from ziria_tpu.utils import dispatch
+
+def cap_bucket(n):
+    return dispatch.pow2_bucket(n, min_bucket=1 << 9)
+'''
+
+R6_TP_TUNABLE_KW = '''
+import jax
+from functools import lru_cache
+
+@lru_cache(maxsize=None)
+def _jit_decode(n_sym_bucket, viterbi_window=0):
+    return jax.jit(lambda y: y)
+
+def go(samples, n):
+    return _jit_decode(n, viterbi_window=64)(samples)
+'''
+
+R6_NEGATIVE = '''
+import jax
+from functools import lru_cache
+from ziria_tpu.utils.dispatch import pow2_bucket
+
+@lru_cache(maxsize=None)
+def _jit_decode(n_sym_bucket, viterbi_window=0):
+    return jax.jit(lambda y: y)
+
+def go(samples, n, geo):
+    b = pow2_bucket(n, geo.sym_bucket_min)   # floor from Geometry: ok
+    w = geo.resolve().viterbi_window
+    return _jit_decode(b, viterbi_window=w)(samples)
+
+def configure(report):
+    # a KNOWN tunable keyword at a NON-factory call: not R6's business
+    return report(chunk_len=8192)
+
+def shape_literal(samples):
+    # positional literals are shape-like plumbing, not named tunables
+    return _jit_decode(8)(samples)
+'''
+
+
+def test_r6_literal_bucket_floor_flagged():
+    f = _findings(R6_TP_BUCKET_FLOOR, rules=["R6"])
+    assert _rules_of(f) == ["R6"] and "pow2_bucket floor" in \
+        f[0].message
+    f = _findings(R6_TP_BUCKET_KW, rules=["R6"])
+    assert _rules_of(f) == ["R6"] and "1 << 9" in f[0].message
+
+
+def test_r6_literal_tunable_keyword_flagged():
+    f = _findings(R6_TP_TUNABLE_KW, rules=["R6"])
+    assert _rules_of(f) == ["R6"]
+    assert "viterbi_window=64" in f[0].message
+    assert "Geometry" in f[0].message
+
+
+def test_r6_near_miss_clean():
+    assert _findings(R6_NEGATIVE, rules=["R6"]) == []
+
+
+def test_r6_is_registered_and_tree_is_clean():
+    # the shipped tree itself passes the new rule — no suppressions
+    # were added to buy this (the cli pragma file predates R6)
+    assert "R6" in RULES_BY_ID
+    src_root = os.path.join(REPO, "ziria_tpu")
+    res = lint_paths([src_root], rules=[RULES_BY_ID["R6"]])
+    assert [f.message for f in res.findings] == []
+
+
 # ------------------------------------------------- pragmas + engine
 
 def test_pragma_suppresses_same_and_previous_line():

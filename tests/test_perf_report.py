@@ -122,6 +122,49 @@ def test_cpu_smoke_never_gates_tpu_runs(tmp_path):
     assert pr.main(["--path", path2, "--check"]) == 1
 
 
+def test_device_kind_mismatch_never_gates(tmp_path):
+    # ISSUE 16: matching extended from platform-only to device_kind.
+    # Two autotune records, both platform=cpu artifacts, but measured
+    # on DIFFERENT device kinds (a v5e winner vs a cpu smoke): the
+    # 100x gap must read as "not comparable", never as a regression
+    path = _write(tmp_path, [
+        _rec("r1", "autotune", 1e8, metric="sps_tuned", t=100,
+             device_kind="TPU v5e"),
+        _rec("r2", "autotune", 1e6, metric="sps_tuned", t=200,
+             device_kind="cpu"),
+    ])
+    assert pr.main(["--path", path, "--check"]) == 0
+    rows, regressions = pr.diff_runs(
+        *[pr.group_runs(pr.load_trajectory(path))[r]
+          for r in ("r1", "r2")])
+    assert regressions == []
+    assert any("device_kind mismatch" in row[-1] for row in rows)
+    # same device kind gates as before
+    path2 = _write(tmp_path, [
+        _rec("r1", "autotune", 1e6, metric="sps_tuned", t=100,
+             device_kind="cpu"),
+        _rec("r2", "autotune", 5e5, metric="sps_tuned", t=200,
+             device_kind="cpu"),
+    ], name="t2.jsonl")
+    assert pr.main(["--path", path2, "--check"]) == 1
+
+
+def test_device_kind_absent_matches_absent(tmp_path):
+    # legacy records (no device_kind field) keep gating each other —
+    # the new key must not amnesty the whole historical ledger
+    path = _write(tmp_path, [
+        _rec("r1", "streaming_rx", 1000.0, t=100),
+        _rec("r2", "streaming_rx", 700.0, t=200),
+    ])
+    assert pr.main(["--path", path, "--check"]) == 1
+    # but a legacy record never gates a device_kind-stamped one
+    path2 = _write(tmp_path, [
+        _rec("r1", "streaming_rx", 1000.0, t=100),
+        _rec("r2", "streaming_rx", 700.0, t=200, device_kind="cpu"),
+    ], name="t2.jsonl")
+    assert pr.main(["--path", path2, "--check"]) == 0
+
+
 def test_numpy_baseline_noise_never_gates(tmp_path):
     # the per-run baseline measurement swings with host load (r4 saw
     # 4.08-6.40 M sps for identical code) — it is ledger context, not

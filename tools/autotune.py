@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Thin launcher for the geometry autotuner (ziria_tpu.utils.autotune)
+so it can run straight from a checkout: cost-pruned measured search,
+per-device winner recorded in BENCH_TRAJECTORY.jsonl. Equivalent to
+`python -m ziria_tpu autotune`; see docs/autotune.md."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from ziria_tpu.utils.autotune import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,7 +30,12 @@ stage (`bench.py` appends them; schema below). This tool reads it:
 Record schema (one JSON object per line):
   {"run_id", "unix", "stage", "metric", "value", "platform",
    "partial", "direction" ("higher"|"lower" = which way better),
-   "source", ["resumed"], ["unit"]}
+   "source", ["resumed"], ["unit"], ["device_kind"], ["geometry"]}
+
+Two records compare only when their ``device_kind`` fields agree
+(absent matches absent): platform alone is too coarse once the
+autotuner records per-device winners — a v5e ``autotune`` record must
+never gate (or be gated by) a CPU smoke's numbers.
 
 Regression = the newer value moving in the WORSE direction by more
 than the tolerance (relative; ``--tolerance 0.1`` = 10%). Per-stage
@@ -143,6 +148,14 @@ def diff_runs(run_a, run_b, tolerance=DEFAULT_TOLERANCE,
             rows.append((stage, metric,
                          ra and ra["value"], rb and rb["value"],
                          None, "only in one run"))
+            continue
+        if ra.get("device_kind") != rb.get("device_kind"):
+            # records are comparable only on the SAME device kind (a
+            # v5e autotune winner must never gate a CPU smoke, even
+            # when both runs are "cpu"-platform artifacts); absent
+            # device_kind matches absent — legacy records keep gating
+            rows.append((stage, metric, ra["value"], rb["value"],
+                         None, "device_kind mismatch — not compared"))
             continue
         a, b = float(ra["value"]), float(rb["value"])
         direction = rb.get("direction", ra.get("direction", "higher"))

@@ -49,6 +49,18 @@ if ! timeout 30 python tools/durability_smoke.py; then
   exit 1
 fi
 
+# geometry smoke (ISSUE 16): the declarative Geometry object's
+# construct/resolve/serialize/tuned() round trip plus the pinned
+# default constants — sub-second, never imports jax (works through
+# TPU probe hangs, like its siblings). A drifted default would break
+# the no-op-by-construction guarantee behind every compiled surface.
+if ! timeout 30 python tools/geometry_smoke.py; then
+  echo "[precommit] geometry smoke FAILED (tools/geometry_smoke.py)" \
+       "— commit refused" >&2
+  echo "[precommit] (ZIRIA_SKIP_TESTGATE=1 to override for WIP)" >&2
+  exit 1
+fi
+
 # perf-ledger regression gate (ISSUE 9): latest vs previous
 # same-platform run in BENCH_TRAJECTORY.jsonl. Lenient tolerance —
 # bench numbers on a shared box are noisy; the gate exists to catch

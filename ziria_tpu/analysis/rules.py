@@ -1,4 +1,4 @@
-"""jaxlint rules: the five JAX-discipline checks tuned to this tree.
+"""jaxlint rules: the six JAX-discipline checks tuned to this tree.
 
 Each rule encodes one recurring bug class of the repo's own history
 (docs/static_analysis.md carries the motivating incident per rule):
@@ -20,6 +20,11 @@ Each rule encodes one recurring bug class of the repo's own history
   R5  cache hygiene — ``lru_cache`` keyed on (or closing over) array
       arguments: unhashable keys at best, an unbounded per-array
       cache at worst.
+  R6  geometry hygiene — a numeric literal for a known tunable
+      (chunk_len / K / S / viterbi window / radix / bucket floors) at
+      a jit-factory call site, or a literal ``pow2_bucket`` floor,
+      bypasses `utils/geometry.Geometry` and forks the compiled
+      geometry from the autotuner's tuned winner (ISSUE 16).
 
 Jit factories are DISCOVERED (an ``@lru_cache`` def whose body calls
 ``jax.jit``), never hardcoded, so the rules keep covering factories
@@ -408,7 +413,74 @@ class CacheHygiene(Rule):
                             f"grows one entry per array object"))
 
 
+#: tunable names R6 refuses as literal keyword arguments at jit-factory
+#: call sites — each has one home on the Geometry dataclass, and a
+#: literal here silently forks the tree's compiled geometry
+KNOWN_TUNABLES = frozenset({
+    "chunk_len", "frame_len", "max_frames_per_chunk", "n_streams",
+    "viterbi_window", "viterbi_radix", "min_bucket",
+})
+
+
+def _is_numeric_literal(node: ast.AST) -> bool:
+    """A compile-time number: ``8192``, ``1 << 13``, ``-1``, or any
+    BinOp/UnaryOp tree over such constants."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) and \
+            not isinstance(node.value, bool)
+    if isinstance(node, ast.BinOp):
+        return _is_numeric_literal(node.left) and \
+            _is_numeric_literal(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_numeric_literal(node.operand)
+    return False
+
+
+class GeometryHygiene(Rule):
+    id = "R6"
+    name = "geometry-hygiene"
+    why = ("a numeric literal for a known tunable at a jit-factory "
+           "call site (or a literal pow2_bucket floor) bypasses the "
+           "Geometry object: the literal and Geometry's default can "
+           "drift apart, and the autotuner's tuned() winner never "
+           "reaches that surface")
+
+    def check(self, ctx: Context) -> None:
+        mod = ctx.module
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = qual_name(node.func).rsplit(".", 1)[-1]
+            if name == "pow2_bucket":
+                floor = None
+                if len(node.args) >= 2:
+                    floor = node.args[1]
+                for k in node.keywords:
+                    if k.arg == "min_bucket":
+                        floor = k.value
+                if floor is not None and _is_numeric_literal(floor):
+                    ctx.report(floor, (
+                        "literal pow2_bucket floor "
+                        f"'{ast.unparse(floor)}': bucket minimums live "
+                        "on the Geometry object (sym_bucket / "
+                        "capture_bucket / bit_bucket) — a literal here "
+                        "forks the bucketing rule from the tuned "
+                        "geometry"))
+            elif JIT_CALLABLE.match(name):
+                for k in node.keywords:
+                    if k.arg in KNOWN_TUNABLES and \
+                            _is_numeric_literal(k.value):
+                        ctx.report(k.value, (
+                            f"literal '{k.arg}="
+                            f"{ast.unparse(k.value)}' at jit-factory "
+                            f"call site '{qual_name(node.func)}': "
+                            f"thread the value from a Geometry "
+                            f"(utils/geometry) so the compile key and "
+                            f"the tuned geometry cannot disagree"))
+
+
 ALL_RULES = (CacheKeyCompleteness(), HostSyncInHotPath(),
-             UntimedDispatch(), EnvReadHygiene(), CacheHygiene())
+             UntimedDispatch(), EnvReadHygiene(), CacheHygiene(),
+             GeometryHygiene())
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

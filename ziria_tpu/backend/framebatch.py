@@ -43,6 +43,7 @@ import numpy as np
 
 from ziria_tpu.backend import chunked as C
 from ziria_tpu.core import ir
+from ziria_tpu.utils import geometry as _geometry
 from ziria_tpu.utils.dispatch import pad_lanes, pow2_ceil
 
 
@@ -693,10 +694,14 @@ class StreamReceiver:
     dropped; widen K or shorten the chunk).
     """
 
-    def __init__(self, chunk_len: int = 1 << 13, frame_len: int = 2048,
-                 max_frames_per_chunk: int = 8, check_fcs: bool = False,
-                 threshold: float = 0.75, min_run: int = 33,
-                 dead_zone: int = 320, viterbi_window: int = None,
+    def __init__(self, chunk_len: Optional[int] = None,
+                 frame_len: Optional[int] = None,
+                 max_frames_per_chunk: Optional[int] = None,
+                 check_fcs: bool = False,
+                 threshold: Optional[float] = None,
+                 min_run: Optional[int] = None,
+                 dead_zone: Optional[int] = None,
+                 viterbi_window: int = None,
                  viterbi_metric: str = None,
                  viterbi_radix: int = None,
                  streaming: Optional[bool] = None,
@@ -705,17 +710,40 @@ class StreamReceiver:
                  watchdog_s: Optional[float] = None,
                  blowup_limit: int = 2, rejoin_after: int = 3,
                  checkpoint: Optional[bytes] = None,
-                 sco_track: Optional[bool] = None):
+                 sco_track: Optional[bool] = None,
+                 geometry: Optional[_geometry.Geometry] = None):
         from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
         from ziria_tpu.runtime import resilience
 
-        if frame_len != _rx._stream_bucket(frame_len):
+        # ONE declarative geometry supplies every default the caller
+        # leaves None (explicit per-knob args still win); the default
+        # Geometry IS the historical constants, so StreamReceiver()
+        # builds exactly yesterday's receiver — same compiled
+        # programs, same checkpoint fingerprint, same bits.
+        geo = geometry if geometry is not None else _geometry.DEFAULT
+        chunk_len = geo.chunk_len if chunk_len is None else chunk_len
+        frame_len = geo.frame_len if frame_len is None else frame_len
+        max_frames_per_chunk = (geo.max_frames_per_chunk
+                                if max_frames_per_chunk is None
+                                else max_frames_per_chunk)
+        threshold = geo.threshold if threshold is None else threshold
+        min_run = geo.min_run if min_run is None else min_run
+        dead_zone = geo.dead_zone if dead_zone is None else dead_zone
+        viterbi_window = (geo.viterbi_window if viterbi_window is None
+                          else viterbi_window)
+        viterbi_metric = (geo.viterbi_metric if viterbi_metric is None
+                          else viterbi_metric)
+        viterbi_radix = (geo.viterbi_radix if viterbi_radix is None
+                         else viterbi_radix)
+        sco_track = geo.sco_track if sco_track is None else sco_track
+
+        if frame_len != geo.capture_bucket(frame_len):
             raise ValueError(
-                f"frame_len {frame_len} is not a power-of-two >= 512 "
-                f"capture bucket; per-capture receive would pad to "
-                f"{_rx._stream_bucket(frame_len)} and the identity "
-                f"contract needs identical geometry")
+                f"frame_len {frame_len} is not a power-of-two >= "
+                f"{geo.capture_bucket_min} capture bucket; per-capture "
+                f"receive would pad to {geo.capture_bucket(frame_len)} "
+                f"and the identity contract needs identical geometry")
         if chunk_len <= frame_len:
             raise ValueError(
                 f"chunk_len {chunk_len} must exceed the frame_len "
@@ -727,7 +755,7 @@ class StreamReceiver:
         # the largest DATA field a frame_len window can hold, bucketed:
         # the stream's ONE fixed decode geometry (longer frames are
         # ACQ_TRUNCATED in both paths — the window cannot hold them)
-        self.n_sym_bucket = _rx._sym_bucket(
+        self.n_sym_bucket = geo.sym_bucket(
             max(1, (self.frame_len - _rx.FRAME_DATA_START) // 80))
         self.check_fcs = check_fcs
         self.viterbi_window = viterbi_window
@@ -1141,16 +1169,19 @@ class StreamReceiver:
             telemetry.count("rx.stream_frames", k, total=self._emitted)
 
 
-def receive_stream(samples, chunk_len: int = 1 << 13,
-                   frame_len: int = 2048,
-                   max_frames_per_chunk: int = 8,
+def receive_stream(samples, chunk_len: Optional[int] = None,
+                   frame_len: Optional[int] = None,
+                   max_frames_per_chunk: Optional[int] = None,
                    check_fcs: bool = False,
-                   threshold: float = 0.75, min_run: int = 33,
-                   dead_zone: int = 320, viterbi_window: int = None,
+                   threshold: Optional[float] = None,
+                   min_run: Optional[int] = None,
+                   dead_zone: Optional[int] = None,
+                   viterbi_window: int = None,
                    viterbi_metric: str = None,
                    viterbi_radix: int = None,
                    streaming: Optional[bool] = None,
-                   sco_track: Optional[bool] = None):
+                   sco_track: Optional[bool] = None,
+                   geometry: Optional[_geometry.Geometry] = None):
     """Decode every frame of a long multi-frame sample stream in
     O(chunks) device dispatches (<= 2 per chunk; 1 for all-noise
     chunks). Returns ``(frames, stats)``: a position-ordered list of
@@ -1166,7 +1197,9 @@ def receive_stream(samples, chunk_len: int = 1 << 13,
     wrapper over :class:`StreamReceiver` — push-driven callers (a live
     capture feed) use the class directly, pushing slabs into one
     receiver whose :class:`StreamCarry` state threads across chunks
-    internally (visible via ``.carry``)."""
+    internally (visible via ``.carry``). ``geometry`` supplies the
+    default for every knob the caller leaves None (one declarative
+    object; explicit arguments win)."""
     sr = StreamReceiver(chunk_len=chunk_len, frame_len=frame_len,
                         max_frames_per_chunk=max_frames_per_chunk,
                         check_fcs=check_fcs, threshold=threshold,
@@ -1174,7 +1207,8 @@ def receive_stream(samples, chunk_len: int = 1 << 13,
                         viterbi_window=viterbi_window,
                         viterbi_metric=viterbi_metric,
                         viterbi_radix=viterbi_radix,
-                        streaming=streaming, sco_track=sco_track)
+                        streaming=streaming, sco_track=sco_track,
+                        geometry=geometry)
     frames = sr.push(samples)
     frames += sr.flush()
     return frames, sr.stats
@@ -1253,29 +1287,55 @@ class MultiStreamReceiver:
     (:class:`StreamCarry`, dedupe watermark included) are visible via
     :meth:`carry`/:attr:`carries`."""
 
-    def __init__(self, n_streams: int, chunk_len: int = 1 << 13,
-                 frame_len: int = 2048, max_frames_per_chunk: int = 8,
-                 check_fcs: bool = False, threshold: float = 0.75,
-                 min_run: int = 33, dead_zone: int = 320,
+    def __init__(self, n_streams: Optional[int] = None,
+                 chunk_len: Optional[int] = None,
+                 frame_len: Optional[int] = None,
+                 max_frames_per_chunk: Optional[int] = None,
+                 check_fcs: bool = False,
+                 threshold: Optional[float] = None,
+                 min_run: Optional[int] = None,
+                 dead_zone: Optional[int] = None,
                  viterbi_window: int = None, viterbi_metric: str = None,
                  viterbi_radix: int = None, mesh=None,
                  axis: str = "dp", sanitize: bool = False,
                  max_retries: Optional[int] = None,
                  watchdog_s: Optional[float] = None,
                  blowup_limit: int = 2, rejoin_after: int = 3,
-                 sco_track: Optional[bool] = None):
+                 sco_track: Optional[bool] = None,
+                 geometry: Optional[_geometry.Geometry] = None):
         from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
         from ziria_tpu.runtime import resilience
 
+        # the declarative-geometry defaults (see StreamReceiver): the
+        # fleet width S rides the same object as the chunk geometry,
+        # so MultiStreamReceiver(geometry=g) builds the whole fleet
+        geo = geometry if geometry is not None else _geometry.DEFAULT
+        n_streams = geo.n_streams if n_streams is None else n_streams
+        chunk_len = geo.chunk_len if chunk_len is None else chunk_len
+        frame_len = geo.frame_len if frame_len is None else frame_len
+        max_frames_per_chunk = (geo.max_frames_per_chunk
+                                if max_frames_per_chunk is None
+                                else max_frames_per_chunk)
+        threshold = geo.threshold if threshold is None else threshold
+        min_run = geo.min_run if min_run is None else min_run
+        dead_zone = geo.dead_zone if dead_zone is None else dead_zone
+        viterbi_window = (geo.viterbi_window if viterbi_window is None
+                          else viterbi_window)
+        viterbi_metric = (geo.viterbi_metric if viterbi_metric is None
+                          else viterbi_metric)
+        viterbi_radix = (geo.viterbi_radix if viterbi_radix is None
+                         else viterbi_radix)
+        sco_track = geo.sco_track if sco_track is None else sco_track
+
         if n_streams < 1:
             raise ValueError(f"n_streams {n_streams} must be >= 1")
-        if frame_len != _rx._stream_bucket(frame_len):
+        if frame_len != geo.capture_bucket(frame_len):
             raise ValueError(
-                f"frame_len {frame_len} is not a power-of-two >= 512 "
-                f"capture bucket; per-capture receive would pad to "
-                f"{_rx._stream_bucket(frame_len)} and the identity "
-                f"contract needs identical geometry")
+                f"frame_len {frame_len} is not a power-of-two >= "
+                f"{geo.capture_bucket_min} capture bucket; per-capture "
+                f"receive would pad to {geo.capture_bucket(frame_len)} "
+                f"and the identity contract needs identical geometry")
         if chunk_len <= frame_len:
             raise ValueError(
                 f"chunk_len {chunk_len} must exceed the frame_len "
@@ -1290,7 +1350,7 @@ class MultiStreamReceiver:
         self.frame_len = int(frame_len)
         self.stride = self.chunk_len - self.frame_len
         self.k = int(max_frames_per_chunk)
-        self.n_sym_bucket = _rx._sym_bucket(
+        self.n_sym_bucket = geo.sym_bucket(
             max(1, (self.frame_len - _rx.FRAME_DATA_START) // 80))
         self.check_fcs = check_fcs
         self.viterbi_window = viterbi_window
@@ -1890,17 +1950,20 @@ class MultiStreamReceiver:
         _record_degraded(False)
 
 
-def receive_streams(streams, chunk_len: int = 1 << 13,
-                    frame_len: int = 2048,
-                    max_frames_per_chunk: int = 8,
+def receive_streams(streams, chunk_len: Optional[int] = None,
+                    frame_len: Optional[int] = None,
+                    max_frames_per_chunk: Optional[int] = None,
                     check_fcs: bool = False,
-                    threshold: float = 0.75, min_run: int = 33,
-                    dead_zone: int = 320, viterbi_window: int = None,
+                    threshold: Optional[float] = None,
+                    min_run: Optional[int] = None,
+                    dead_zone: Optional[int] = None,
+                    viterbi_window: int = None,
                     viterbi_metric: str = None,
                     viterbi_radix: int = None,
                     multi: Optional[bool] = None, mesh=None,
                     axis: str = "dp",
-                    sco_track: Optional[bool] = None):
+                    sco_track: Optional[bool] = None,
+                    geometry: Optional[_geometry.Geometry] = None):
     """Decode S concurrent multi-frame I/Q streams in O(chunk-steps)
     device dispatches — <= 2 per chunk-step *independent of S*.
     Returns ``(per_stream_frames, stats)``: a per-stream position-
@@ -1925,7 +1988,8 @@ def receive_streams(streams, chunk_len: int = 1 << 13,
               min_run=min_run, dead_zone=dead_zone,
               viterbi_window=viterbi_window,
               viterbi_metric=viterbi_metric,
-              viterbi_radix=viterbi_radix, sco_track=sco_track)
+              viterbi_radix=viterbi_radix, sco_track=sco_track,
+              geometry=geometry)
     if not multi_stream_enabled(multi):
         if mesh is not None:
             # a sharded-vs-oracle comparison must never silently

@@ -213,19 +213,15 @@ def viterbi_mode() -> tuple:
     be the only guard). An unparseable window degrades to 0 (off, the
     safe default); an unknown metric or radix raises — the quantized
     kernels are an opt-in accuracy trade and the radix an opt-in
-    kernel rewrite, neither of which may be silently dropped."""
-    import os as _os
+    kernel rewrite, neither of which may be silently dropped.
 
-    from ziria_tpu.ops.viterbi import METRIC_DTYPES, _check_radix
-    try:
-        win = int(_os.environ.get("ZIRIA_VITERBI_WINDOW", "0"))
-    except ValueError:
-        win = 0
-    md = _os.environ.get("ZIRIA_VITERBI_METRIC") or "float32"
-    if md not in METRIC_DTYPES:
-        raise ValueError(
-            f"ZIRIA_VITERBI_METRIC={md!r} is not one of {METRIC_DTYPES}")
-    return win, md, _check_radix(None)
+    The env reads themselves live with the geometry object's
+    designated readers (utils/geometry): this triple is exactly the
+    resolved default Geometry's decode mode."""
+    from ziria_tpu.utils.geometry import Geometry
+
+    g = Geometry().resolve()
+    return g.viterbi_window, g.viterbi_metric, g.viterbi_radix
 
 
 def _viterbi_soft(llrs, npairs, nbits):

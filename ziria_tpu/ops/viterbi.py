@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ziria_tpu.ops.coding import G0, G1, K
+from ziria_tpu.utils import geometry as _geometry
 
 N_STATES = 64
 
@@ -85,14 +86,17 @@ I16_MIN, I16_MAX = -(1 << 15), (1 << 15) - 1
 # (tests/test_viterbi_radix4.py), not bit identity.
 INT8_QUANT_MAX = 15
 I8_MIN, I8_MAX = -(1 << 7), (1 << 7) - 1
-METRIC_DTYPES = ("float32", "int16", "int8")
+# the valid-metric set lives with the geometry object (the declared
+# search space of the autotuner) — aliased here so kernel code and
+# error messages keep their historical spelling
+METRIC_DTYPES = _geometry.VITERBI_METRICS
 
 # radix of the Pallas ACS sweep: 2 = one trellis step per kernel
 # iteration (the oracle), 4 = two steps fused per iteration (butterfly
 # pairs collapsed — half the sequential dependency chain), decode
 # bit-identical to radix 2 at float32 and int16 by construction
 # (ops/viterbi_pallas.py derives it). The lax.scan decoders ignore it.
-RADIXES = (2, 4)
+RADIXES = _geometry.VITERBI_RADIXES
 
 
 def quantize_llrs(llrs, qmax: int = QUANT_MAX):
@@ -133,20 +137,12 @@ def _check_radix(radix) -> int:
     surface resolves BEFORE building a cache key (the viterbi_metric
     discipline: an env change after tracing must re-trace, never
     silently reuse the other radix's program)."""
-    from_env = radix is None
-    if from_env:
-        import os
-        raw = os.environ.get("ZIRIA_VITERBI_RADIX") or "2"
-        try:
-            radix = int(raw)
-        except ValueError:
-            raise ValueError(
-                f"ZIRIA_VITERBI_RADIX={raw!r} is not one of {RADIXES}")
+    if radix is None:
+        # the env default lives with the geometry object's designated
+        # readers (utils/geometry — validation included, same raises)
+        return _geometry.env_viterbi_radix()
     radix = int(radix)
     if radix not in RADIXES:
-        if from_env:
-            raise ValueError(
-                f"ZIRIA_VITERBI_RADIX={radix!r} is not one of {RADIXES}")
         raise ValueError(f"viterbi radix {radix!r} is not one of {RADIXES}")
     return radix
 

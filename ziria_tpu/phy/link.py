@@ -180,7 +180,8 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
                   viterbi_metric: str = None,
                   viterbi_radix: int = None,
                   channel_profile=None,
-                  sco_track: Optional[bool] = None) -> List:
+                  sco_track: Optional[bool] = None,
+                  geometry=None) -> List:
     """The full N-frame mixed-rate loopback. Default: the FUSED path —
     encode → per-lane channel impairments → acquire → classify →
     gather → mixed-rate decode → batched CRC as ONE jitted device
@@ -216,6 +217,17 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
     dly = _lane_param(delay, n, np.int32)
     if (dly < 0).any():
         raise ValueError("negative delay")
+    # a Geometry fills only the knobs the caller left at None — explicit
+    # per-call arguments still win (utils/geometry contract)
+    if geometry is not None:
+        viterbi_window = (geometry.viterbi_window
+                          if viterbi_window is None else viterbi_window)
+        viterbi_metric = (geometry.viterbi_metric
+                          if viterbi_metric is None else viterbi_metric)
+        viterbi_radix = (geometry.viterbi_radix
+                         if viterbi_radix is None else viterbi_radix)
+        sco_track = (geometry.sco_track
+                     if sco_track is None else sco_track)
     # resolved ONCE here so the per-frame oracle, the staged path, and
     # the fused graph's compile-cache key all see the same radix,
     # per-lane profile names, and sco_track value

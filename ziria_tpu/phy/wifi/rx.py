@@ -88,11 +88,13 @@ def sco_track_enabled(sco_track=None) -> bool:
     exactly zero): whether `pilot_phase_correct` additionally fits
     and removes the per-subcarrier phase RAMP a sampling-clock
     offset induces (docs/robustness.md). Callers resolve once and
-    pass the bool into the decode jit factories' cache keys."""
+    pass the bool into the decode jit factories' cache keys. The env
+    read itself lives with the geometry object's designated reader
+    (utils/geometry.env_sco_track)."""
     if sco_track is not None:
         return bool(sco_track)
-    import os
-    return os.environ.get("ZIRIA_RX_SCO_TRACK", "0") == "1"
+    from ziria_tpu.utils.geometry import env_sco_track
+    return env_sco_track()
 
 
 def pilot_phase_correct(data, pilots, symbol_index0: int,
@@ -195,11 +197,13 @@ def fused_demap_enabled(fused_demap=None) -> bool:
     (default OFF — the XLA front end is the oracle): whether the
     known-rate DATA decodes run demap + deinterleave + depuncture as
     an in-kernel prologue of the Pallas ACS (LLRs produced and
-    consumed in VMEM, never round-tripping HBM)."""
+    consumed in VMEM, never round-tripping HBM). The env read itself
+    lives with the geometry object's designated reader
+    (utils/geometry.env_fused_demap)."""
     if fused_demap is not None:
         return fused_demap
-    import os
-    return os.environ.get("ZIRIA_FUSED_DEMAP", "0") == "1"
+    from ziria_tpu.utils.geometry import env_fused_demap
+    return env_fused_demap()
 
 
 def _fused_front_applies(viterbi_window, viterbi_metric) -> bool:
@@ -410,12 +414,14 @@ def _jit_decode_data_bucketed(rate_mbps: int, n_sym_bucket: int,
 
 
 def _sym_bucket(n_sym: int) -> int:
-    """Power-of-two symbol bucket (min 4 keeps tiny frames in one
+    """Power-of-two symbol bucket (the floor keeps tiny frames in one
     compile class). Shared with the TX batch path (tx.encode_many
     buckets its symbol counts with the same rule, so a loopback's
-    encode and decode geometries agree)."""
-    from ziria_tpu.utils.dispatch import pow2_bucket
-    return pow2_bucket(n_sym, 4)
+    encode and decode geometries agree) — the rule itself lives on the
+    Geometry object (utils/geometry; jaxlint R6 flags literal
+    floors)."""
+    from ziria_tpu.utils.geometry import DEFAULT
+    return DEFAULT.sym_bucket(n_sym)
 
 
 # ------------------------------------------------------- mixed-rate dispatch
@@ -570,11 +576,13 @@ class _Acquired(NamedTuple):
 
 
 def _stream_bucket(n: int) -> int:
-    """Power-of-two capture bucket (min 512): the ONE padding formula
-    the per-capture and batched acquisition paths share — their
-    bit-identity contract assumes identical padded geometry rules."""
-    from ziria_tpu.utils.dispatch import pow2_bucket
-    return pow2_bucket(n, 512)
+    """Power-of-two capture bucket: the ONE padding formula the
+    per-capture and batched acquisition paths share — their
+    bit-identity contract assumes identical padded geometry rules.
+    The rule (and its floor) lives on the Geometry object
+    (utils/geometry; jaxlint R6 flags literal floors)."""
+    from ziria_tpu.utils.geometry import DEFAULT
+    return DEFAULT.capture_bucket(n)
 
 
 def _bucket_pad(x: np.ndarray):
@@ -1146,7 +1154,8 @@ def receive(samples, check_fcs: bool = False,
             viterbi_metric: str = None,
             viterbi_radix: int = None,
             fused_demap: bool = None,
-            sco_track: bool = None) -> RxResult:
+            sco_track: bool = None,
+            geometry=None) -> RxResult:
     """Host-side receiver driver: detect, align, CFO-correct, parse
     SIGNAL, dispatch the per-rate decoder — the jit analogue of the
     reference's header-driven rate dispatch. The data decode compiles
@@ -1177,7 +1186,24 @@ def receive(samples, check_fcs: bool = False,
     pinned bit-identical and a fitted slope is never exactly zero);
     the bounded-|H| null-subcarrier guard is always on and value-
     inert on flat channels. Both ignored under fxp.
+
+    ``geometry`` (a utils/geometry.Geometry) supplies the default for
+    every decode-mode knob the caller leaves None — one declarative
+    object instead of five threaded parameters; explicit per-knob
+    arguments still win. The default Geometry reproduces the legacy
+    env-resolution path exactly (same compiled programs, same bits).
     """
+    if geometry is not None:
+        viterbi_window = (geometry.viterbi_window
+                          if viterbi_window is None else viterbi_window)
+        viterbi_metric = (geometry.viterbi_metric
+                          if viterbi_metric is None else viterbi_metric)
+        viterbi_radix = (geometry.viterbi_radix
+                         if viterbi_radix is None else viterbi_radix)
+        fused_demap = (geometry.fused_demap
+                       if fused_demap is None else fused_demap)
+        sco_track = (geometry.sco_track
+                     if sco_track is None else sco_track)
     res, acq = _acquire_frame(samples, max_samples)
     if acq is None:
         return res

@@ -43,8 +43,9 @@ from ziria_tpu.ops.crc import append_crc32
 from ziria_tpu.phy.wifi.params import (N_SERVICE_BITS, N_TAIL_BITS,
                                        RATE_INDEX, RATE_MBPS_ORDER,
                                        RateParams, RATES, n_symbols)
+from ziria_tpu.utils import geometry as _geometry
 from ziria_tpu.utils.bits import bytes_to_bits, uint_to_bits
-from ziria_tpu.utils.dispatch import pad_lanes, pow2_bucket
+from ziria_tpu.utils.dispatch import pad_lanes
 
 # the standard's example frame seed; callers may override per frame
 DEFAULT_SCRAMBLER_SEED = 0b1011101
@@ -124,16 +125,16 @@ def encode_frame_bits(psdu_bits, rate: RateParams) -> jnp.ndarray:
 
 
 def _sym_bucket(n_sym: int) -> int:
-    """Power-of-two symbol bucket, floor 4 — the SAME rule as
-    rx._sym_bucket (both sides call utils/dispatch.pow2_bucket), so a
+    """Power-of-two symbol bucket — the SAME rule as rx._sym_bucket
+    (both sides share the Geometry object's bucket rule), so a
     loopback's encode and decode geometries agree by construction."""
-    return pow2_bucket(n_sym, 4)
+    return _geometry.DEFAULT.sym_bucket(n_sym)
 
 
 def _bit_bucket(n_bits: int) -> int:
-    """Power-of-two PSDU bit bucket (min 128 keeps tiny frames — ACKs,
-    MAC control — in one compile class)."""
-    return pow2_bucket(n_bits, 128)
+    """Power-of-two PSDU bit bucket (the Geometry floor keeps tiny
+    frames — ACKs, MAC control — in one compile class)."""
+    return _geometry.DEFAULT.bit_bucket(n_bits)
 
 
 def encode_frame_bits_bucketed(psdu_bits_padded, n_bits_real,

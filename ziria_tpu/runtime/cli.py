@@ -138,7 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
                "observatory (CPU-pinned XLA cost/memory attribution; "
                "docs/observability.md); `python -m ziria_tpu serve "
                "[--sessions N] [--chaos SPEC]` runs the "
-               "continuous-batching serving demo (docs/serving.md)")
+               "continuous-batching serving demo (docs/serving.md); "
+               "`python -m ziria_tpu autotune [--frames N] [--reps N]` "
+               "runs the cost-pruned measured geometry search and "
+               "records the per-device winner in the bench ledger "
+               "(docs/autotune.md)")
     p.add_argument("--prog", help="registered pipeline name")
     p.add_argument("--src", help="Ziria-like source file (.zir) to compile")
     p.add_argument("--list-progs", action="store_true")
@@ -767,6 +771,12 @@ def main(argv=None) -> int:
         # itself, so cost attribution works while the TPU probe hangs.
         from ziria_tpu.utils.programs import main as programs_main
         return programs_main(argv[1:])
+    if argv and argv[0] == "autotune":
+        # geometry autotuner (utils/autotune, docs/autotune.md):
+        # cost-pruned measured search; pre-argparse like `lint` —
+        # the winner lands keyed by device_kind in the bench ledger
+        from ziria_tpu.utils.autotune import main as autotune_main
+        return autotune_main(argv[1:])
     if argv and argv[0] == "serve":
         # continuous-batching serving demo (runtime/serve,
         # docs/serving.md): synthetic many-client load through the

@@ -78,18 +78,26 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, \
 import numpy as np
 
 from ziria_tpu.runtime import durability, resilience
-from ziria_tpu.utils import dispatch, faults, telemetry
+from ziria_tpu.utils import dispatch, faults, geometry as _geometry, \
+    telemetry
+
+# the single source of the fleet-geometry defaults below (jax-free,
+# like this module) — ServeConfig() and StreamReceiver() can never
+# drift apart on chunk_len/frame_len/K/S again
+_GEO = _geometry.DEFAULT
 
 
 class ServeConfig(NamedTuple):
     """The server's fixed shape. The first five fields are the
-    compiled fleet geometry (`MultiStreamReceiver`'s — admission
-    churn never changes them, so the two fleet programs compile
-    once); the rest are host-side protocol bounds."""
-    n_lanes: int = 8                 # S: concurrent sessions on device
-    chunk_len: int = 1 << 13
-    frame_len: int = 2048
-    max_frames_per_chunk: int = 8
+    compiled fleet geometry (`MultiStreamReceiver`'s, defaults
+    inherited from :data:`ziria_tpu.utils.geometry.DEFAULT` —
+    admission churn never changes them, so the two fleet programs
+    compile once); the rest are host-side protocol bounds. Build
+    from a tuned geometry with :meth:`from_geometry`."""
+    n_lanes: int = _GEO.n_streams    # S: concurrent sessions on device
+    chunk_len: int = _GEO.chunk_len
+    frame_len: int = _GEO.frame_len
+    max_frames_per_chunk: int = _GEO.max_frames_per_chunk
     check_fcs: bool = False
     queue_cap: int = 16              # admission queue bound
     max_slab_samples: int = 1 << 16  # oversized-slab reject bound
@@ -110,6 +118,18 @@ class ServeConfig(NamedTuple):
     journal_segment_records: int = 256
     jitter_seed: int = 0             # retry-after hint jitter seed
     shard: bool = False              # elastic dp mesh over the lanes
+
+    @classmethod
+    def from_geometry(cls, geo: "_geometry.Geometry",
+                      **overrides: Any) -> "ServeConfig":
+        """Config whose fleet-geometry fields come from ``geo`` (e.g.
+        ``Geometry.tuned(device_kind)``); host-protocol fields keep
+        their defaults unless overridden."""
+        fields = dict(n_lanes=geo.n_streams, chunk_len=geo.chunk_len,
+                      frame_len=geo.frame_len,
+                      max_frames_per_chunk=geo.max_frames_per_chunk)
+        fields.update(overrides)
+        return cls(**fields)
 
 
 class AdmitResult(NamedTuple):
