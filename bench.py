@@ -92,6 +92,7 @@ STAGE_METRICS = {
     "viterbi_breakdown": ("t_full_s", "lower"),
     "viterbi_kernel_stats": ("sps_base", "higher"),
     "mixed_dispatch": ("sps_mixed", "higher"),
+    "fused_mixed": ("sps_fused_mixed", "higher"),
     "batched_acquire": ("sps_batched_acquire", "higher"),
     "link_loopback": ("fps_batched", "higher"),
     "fused_link": ("fps_fused", "higher"),
@@ -1307,6 +1308,42 @@ def _child_main(run_id):
         except Exception as e:          # evidence stage: never fatal
             note(f"mixed dispatch stage failed: {e!r}")
             mixed_ev = {"error": repr(e)}
+
+    # ISSUE 20 tentpole evidence: the rate-switched fused decode on
+    # the mixed/stream path — identity-gated (lane-for-lane vs the
+    # unfused mixed trellis, radix 2 and 4) with the analytical
+    # cost_of(_jit_stream_decode) bytes_accessed delta fused vs
+    # unfused at the suite-shared geometry. On CPU the fused sps pays
+    # interpret-mode dispatch overhead for the in-kernel 8-rate front
+    # (the win is priced by the bytes delta until the TPU probe
+    # lands); the stage records both sides either way. Same
+    # resumable, never-fatal discipline as mixed_dispatch above.
+    def _fused_mixed_stage():
+        if time.time() - t0 > 0.935 * budget:
+            raise TimeoutError("skipped: child time budget")
+        smoke = os.environ.get("ZIRIA_BENCH_ALLOW_CPU") == "1"
+        ev = _load_rx_dispatch_bench().fused_mixed_stats(
+            B=8 if smoke else 64, n_bytes=24 if smoke else 100,
+            k1=2, k2=4 if smoke else 6)
+        note(f"fused mixed: identity "
+             f"{ev['fused_mixed_bit_identical']}, stream decode bytes "
+             f"{ev['stream_decode_bytes_unfused']/1e6:.1f}M -> "
+             f"{ev['stream_decode_bytes_fused']/1e6:.1f}M "
+             f"({ev['stream_decode_bytes_ratio']:.2f}x), "
+             f"sps {ev['sps_unfused_mixed']/1e3:.0f}k -> "
+             f"{ev['sps_fused_mixed']/1e3:.0f}k")
+        part("fused_mixed", **ev)
+        return ev
+
+    if "fused_mixed" in resume:
+        fused_mixed_ev = reuse(resume["fused_mixed"])
+        note("fused mixed resumed from prior window")
+    else:
+        try:
+            fused_mixed_ev = _fused_mixed_stage()
+        except Exception as e:          # evidence stage: never fatal
+            note(f"fused mixed stage failed: {e!r}")
+            fused_mixed_ev = {"error": repr(e)}
 
     # ISSUE 2 tentpole evidence: the acquisition front end's
     # O(N) -> O(1) dispatch collapse (receive_many batched_acquire),

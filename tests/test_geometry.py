@@ -280,6 +280,26 @@ def _fake_search_space(base):
     return cands, costs, speeds
 
 
+def test_default_candidates_carry_fused_demap_axis():
+    # ISSUE 20: the rate-switched fused front makes fused_demap a
+    # measured axis on the mixed/stream path — the default search
+    # space must offer the lever alone AND the joint chunk x fused
+    # move (the fused kernel shifts the bytes/flops balance, so the
+    # chunk length that wins unfused need not win fused)
+    base = Geometry().resolve()
+    assert not base.fused_demap
+    cands = dict(autotune.default_candidates(base))
+    assert cands["fused_demap"].fused_demap is True
+    assert cands["fused_demap"].chunk_len == base.chunk_len
+    joint = cands[f"chunk{base.chunk_len * 2}_fused"]
+    assert joint.fused_demap is True
+    assert joint.chunk_len == base.chunk_len * 2
+    # an already-fused base does not re-offer the axis
+    fused_base = base.replace(fused_demap=True)
+    assert not any("fused" in label for label, _ in
+                   autotune.default_candidates(fused_base))
+
+
 def test_autotune_cost_prune_rejects_analytically_worse():
     base = Geometry().resolve()
     cands, costs, speeds = _fake_search_space(base)

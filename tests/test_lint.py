@@ -165,6 +165,55 @@ def test_r1_reflags_dropped_cache_key_param_in_real_rx_factory():
                and "fused_demap_enabled" in x.message for x in f)
 
 
+@pytest.mark.parametrize("factory", ["_jit_decode_data_mixed",
+                                     "_jit_stream_decode",
+                                     "_jit_stream_decode_multi"])
+def test_r1_guards_fused_demap_key_in_mixed_decode_factories(factory):
+    """ISSUE 20 satellite: every MIXED-decode jit factory now carries
+    `fused_demap` as its LAST cache-key parameter (the rate-switched
+    fused front). Same demo as the bucketed factory above: AST-drop
+    the parameter by position and resolve it in the body — R1 must
+    re-flag each mutated factory, and the real file stays clean (the
+    clean check rides the bucketed test; one parse per mutation
+    here)."""
+    with open(RX_PY, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src)
+
+    class DropKeyParam(ast.NodeTransformer):
+        mutated = False
+
+        def visit_FunctionDef(self, node):
+            self.generic_visit(node)
+            if node.name != factory:
+                return node
+            assert node.args.args[-1].arg == "fused_demap"
+            node.args.args = node.args.args[:-1]
+            node.args.defaults = node.args.defaults[:-1]
+
+            class Resolve(ast.NodeTransformer):
+                def visit_Name(self, n):
+                    if n.id == "fused_demap" and isinstance(
+                            n.ctx, ast.Load):
+                        return ast.copy_location(ast.Call(
+                            func=ast.Name("fused_demap_enabled",
+                                          ast.Load()),
+                            args=[ast.Constant(None)], keywords=[]), n)
+                    return n
+
+            Resolve().visit(node)
+            DropKeyParam.mutated = True
+            return node
+
+    mutated = ast.unparse(ast.fix_missing_locations(
+        DropKeyParam().visit(tree)))
+    assert DropKeyParam.mutated, f"{factory} not found in rx.py"
+    f = _findings(mutated, rules=["R1"], path="rx_mutated.py")
+    assert any(factory in x.message
+               and "fused_demap_enabled" in x.message for x in f), \
+        f"R1 must re-flag {factory}'s dropped fused_demap key"
+
+
 # ------------------------------------------------------------------ R2
 
 R2_TP = '''

@@ -44,6 +44,13 @@ CHUNK, FRAME_LEN, K, SYM_B = 4096, 1024, 8, 8
 #   lower = pin / 3, upper = pin * 1.8
 STREAM_CHUNK_PIN = {"flops": 11732372.0, "bytes_accessed": 3172926.0}
 STREAM_DECODE_PIN = {"flops": 30006368.0, "bytes_accessed": 72476368.0}
+# the ISSUE 20 fused twin: the same stream-decode program with the
+# rate-switched fused front (fused_demap=True) — LLRs produced and
+# consumed in VMEM, so bytes_accessed drops to ~0.58x the unfused pin
+# (the fori-loop kernel body is also what the analytical model
+# bills, one sub-block not MIXED_UNROLL straight-line steps)
+STREAM_DECODE_FUSED_PIN = {"flops": 31700852.0,
+                           "bytes_accessed": 42078772.0}
 
 
 def _tier1_driver():
@@ -181,6 +188,26 @@ def test_stream_decode_cost_pinned():
     cost = P.cost_of(fn, S((K, need_b, 2), jnp.float32), S((K,), i32),
                      S((K,), i32), S((K,), i32), S((K,), i32))
     _pin_check(cost, STREAM_DECODE_PIN)
+
+
+def test_stream_decode_fused_cost_pinned_below_unfused():
+    # the ISSUE 20 acceptance gate: at the suite-shared geometry the
+    # fused stream decode must bill STRICTLY fewer bytes than the
+    # unfused program it replaces (the whole point of keeping LLRs in
+    # VMEM), and its own cost stays pinned so a wrapper regression
+    # (e.g. a bank re-materialized per chunk) fails tier-1 loudly
+    need_b = rx.FRAME_DATA_START + 80 * SYM_B
+    S, i32 = jax.ShapeDtypeStruct, jnp.int32
+    avals = (S((K, need_b, 2), jnp.float32), S((K,), i32),
+             S((K,), i32), S((K,), i32), S((K,), i32))
+    cost_u = P.cost_of(rx._jit_stream_decode(SYM_B, None, None, 2),
+                       *avals)
+    cost_f = P.cost_of(
+        rx._jit_stream_decode(SYM_B, None, None, 2, False, True),
+        *avals)
+    _pin_check(cost_f, STREAM_DECODE_FUSED_PIN)
+    assert cost_f["bytes_accessed"] < cost_u["bytes_accessed"], (
+        cost_f, cost_u)
 
 
 # ----------------------------------------------------------- observatory

@@ -1,0 +1,173 @@
+"""Rate-SWITCHED fused decode (ISSUE 20): the mixed `lax.switch`
+decode with demap + deinterleave + depuncture executed in-kernel from
+one stacked all-rates slot-table bank.
+
+Two contracts pinned here:
+
+1. The constant bank itself (jax-free, no trace, no compile): every
+   (rate, chunk) row of `mixed_front_tables()` must equal what the
+   XLA front end's own primitives — `demap.demap_bit_layout`,
+   `interleave.deinterleave_slots`, `coding.PUNCTURE_KEEP` — emit for
+   those 24 depunctured slots, re-derived independently slot by slot.
+   If demap or the interleaver ever changes, the bank pin fails
+   before any kernel runs.
+
+2. Lane-for-lane bit-identity of `decode_data_mixed(fused_demap=True)`
+   vs the unfused mixed decode on an all-8-rates batch, over each
+   lane's real bit prefix (past `n_bits_real` both paths decode
+   zero-LLR erasures whose tie-broken bits carry no contract).
+
+Budget discipline follows the known-rate fused tests
+(test_viterbi_radix4): tier-1 compiles ONE mixed-fused kernel program
+(the 8-symbol bucket, radix 2); the 16-symbol bucket class, the
+radix-4 stack, and the quantized fallbacks ride the tier-2 ``slow``
+marker. The end-to-end surface pins (receive_many / streaming /
+fused link) live with their surfaces' own suites and the
+`fused_mixed` bench stage.
+"""
+
+import numpy as np
+import pytest
+
+from ziria_tpu.ops import viterbi_pallas as vp
+
+ALL_MBPS = (6, 9, 12, 18, 24, 36, 48, 54)
+
+
+# ------------------------------------------------- bank pin (jax-free)
+
+
+def test_mixed_bank_rows_pin_front_primitives():
+    # independent slot-by-slot re-derivation — deliberately NOT via
+    # _front_tables, so the bank is pinned to the primitives, not to
+    # the code path that builds it
+    from ziria_tpu.ops.coding import PUNCTURE_KEEP
+    from ziria_tpu.ops.demap import demap_bit_layout
+    from ziria_tpu.ops.interleave import deinterleave_slots
+    from ziria_tpu.phy.wifi.params import RATE_MBPS_ORDER, RATES
+
+    assert tuple(RATE_MBPS_ORDER) == ALL_MBPS
+    bank_x, bank_l = vp.mixed_front_tables()
+    assert bank_x.shape == (8, vp.MIXED_CHUNKS, 2 * vp.MIXED_SUB, 96)
+    assert bank_l.shape == (8, vp.MIXED_CHUNKS, 2 * vp.MIXED_SUB, 8)
+
+    for r, m in enumerate(RATE_MBPS_ORDER):
+        rate = RATES[m]
+        # the sub-block algebra the kernel relies on: every rate's
+        # n_dbps is a multiple of MIXED_SUB, the bank is wide enough
+        assert rate.n_dbps % vp.MIXED_SUB == 0
+        cyc = rate.n_dbps // vp.MIXED_SUB
+        assert cyc <= vp.MIXED_CHUNKS
+        # chunks at/after the rate's cycle stay zero (never selected)
+        assert not bank_x[r, cyc:].any()
+        assert not bank_l[r, cyc:].any()
+
+        keep = PUNCTURE_KEEP[rate.coding]
+        period, kept = keep.size, int(keep.sum())
+        nkeep_before = np.cumsum(keep) - keep
+        sub, bit = deinterleave_slots(rate.n_cbps, rate.n_bpsc)
+        comp, lev, amp = demap_bit_layout(rate.n_bpsc)
+        for p in range(2 * rate.n_dbps):    # depunctured slot index
+            c, row = divmod(p, 2 * vp.MIXED_SUB)
+            ex = np.zeros(96, np.float32)
+            el = np.zeros(8, np.float32)
+            blk, off = divmod(p, period)
+            if keep[off]:
+                q = blk * kept + int(nkeep_before[off])
+                sc, b = int(sub[q]), int(bit[q])
+                ex[2 * sc + int(comp[b])] = 1.0
+                el[int(lev[b])] = 1.0
+                el[3] = float(amp[b])
+                el[4] = 1.0        # depuncture validity
+            np.testing.assert_array_equal(bank_x[r, c, row], ex,
+                                          err_msg=f"rate {m} slot {p}")
+            np.testing.assert_array_equal(bank_l[r, c, row], el,
+                                          err_msg=f"rate {m} slot {p}")
+
+
+def test_mixed_bank_matches_known_rate_tables():
+    # the two fused fronts must share one table source: bank row r is
+    # exactly the known-rate `_front_tables` split into 24-row chunks
+    from ziria_tpu.phy.wifi.params import RATE_MBPS_ORDER, RATES
+
+    bank_x, bank_l = vp.mixed_front_tables()
+    for r, m in enumerate(RATE_MBPS_ORDER):
+        rate = RATES[m]
+        sel_x, _sel_g, lcols = vp._front_tables(
+            rate.n_bpsc, rate.n_cbps, rate.n_dbps, rate.coding)
+        cyc = rate.n_dbps // vp.MIXED_SUB
+        t2 = 2 * vp.MIXED_SUB
+        np.testing.assert_array_equal(
+            bank_x[r, :cyc].reshape(cyc * t2, 96), sel_x)
+        np.testing.assert_array_equal(
+            bank_l[r, :cyc].reshape(cyc * t2, 8), lcols)
+
+
+# --------------------------------------------- decode identity (compiled)
+
+
+def _mixed_batch(n_bytes, seed, noise=0.03):
+    """One noisy frame per rate, padded to the common symbol bucket —
+    the shape decode_data_mixed takes on every fleet surface."""
+    from ziria_tpu.phy.wifi import rx, tx
+    from ziria_tpu.phy.wifi.params import RATES, n_symbols
+
+    rng = np.random.default_rng(seed)
+    n_sym_b = rx._sym_bucket(max(n_symbols(n_bytes, RATES[m])
+                                 for m in ALL_MBPS))
+    need = rx.FRAME_DATA_START + 80 * n_sym_b
+    frames = np.zeros((len(ALL_MBPS), need, 2), np.float32)
+    for i, m in enumerate(ALL_MBPS):
+        psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+        s = np.asarray(tx.encode_frame(psdu, m))
+        frames[i, :min(len(s), need)] = s[:min(len(s), need)]
+    frames += rng.normal(0, noise, frames.shape).astype(np.float32)
+    ridx = np.asarray([rx.RATE_INDEX[m] for m in ALL_MBPS], np.int32)
+    nbits = np.asarray([n_symbols(n_bytes, RATES[m]) * RATES[m].n_dbps
+                        for m in ALL_MBPS], np.int32)
+    return frames, ridx, nbits, n_sym_b
+
+
+def _assert_fused_identical(frames, ridx, nbits, n_sym_b, **kw):
+    from ziria_tpu.phy.wifi import rx
+
+    base = np.asarray(rx.decode_data_mixed(
+        frames, ridx, nbits, n_sym_b, fused_demap=False, **kw))
+    fused = np.asarray(rx.decode_data_mixed(
+        frames, ridx, nbits, n_sym_b, fused_demap=True, **kw))
+    mask = np.arange(base.shape[1])[None, :] < nbits[:, None]
+    np.testing.assert_array_equal(fused[mask], base[mask])
+    return base
+
+
+def test_mixed_fused_bit_identical_all_rates_bucket8():
+    # tier-1 pin: one batch with every rate, the 8-symbol bucket class
+    # (the suite-shared streaming geometry), radix 2 — lane-for-lane
+    # over each lane's real prefix
+    frames, ridx, nbits, n_sym_b = _mixed_batch(12, seed=20)
+    assert n_sym_b == 8
+    _assert_fused_identical(frames, ridx, nbits, n_sym_b)
+
+
+@pytest.mark.slow
+def test_mixed_fused_bit_identical_bucket16_and_radix4():
+    # the second spb class (16-symbol bucket) and the radix-4 stack —
+    # two more interpret-mode kernel programs, minutes on CPU,
+    # milliseconds of Mosaic compile on the chip
+    frames, ridx, nbits, n_sym_b = _mixed_batch(24, seed=21)
+    assert n_sym_b == 16
+    _assert_fused_identical(frames, ridx, nbits, n_sym_b)
+    _assert_fused_identical(frames, ridx, nbits, n_sym_b,
+                            viterbi_radix=4)
+
+
+@pytest.mark.slow
+def test_mixed_fused_quantized_windowed_fall_back():
+    # composition rule (same as the known-rate front): int16/int8 and
+    # windowed decodes keep the unfused front — fused_demap=True must
+    # be a no-op, so "identity" is exact program equality, int8's BER
+    # envelope included by construction
+    frames, ridx, nbits, n_sym_b = _mixed_batch(12, seed=22)
+    for kw in ({"viterbi_metric": "int16"}, {"viterbi_metric": "int8"},
+               {"viterbi_window": 512}):
+        _assert_fused_identical(frames, ridx, nbits, n_sym_b, **kw)

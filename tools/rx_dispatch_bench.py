@@ -1550,6 +1550,122 @@ def viterbi_kernel_stats(B=128, n_bytes=1000, rate_mbps=54,
     return out
 
 
+def fused_mixed_stats(B=64, n_bytes=100, noise_sigma=0.3, k1=2, k2=6,
+                      frame_len=1024, stream_k=8):
+    """The rate-SWITCHED fused-demap lever (ISSUE 20) — the mixed
+    `lax.switch` decode every streaming/fleet surface runs, with the
+    8-rate stacked constant bank row-selected in-kernel:
+
+    - identity gate: `rx.decode_data_mixed(fused_demap=True)` vs the
+      unfused mixed oracle on a noisy all-8-rates batch, per-lane
+      real-prefix mismatch fraction recorded and asserted vanishing
+      (the radix-4 stack too — same budget as the known-rate fused
+      levers in `viterbi_kernel_stats`);
+    - marginal step time (K-spread) for the unfused and fused mixed
+      decode -> `sps_fused_mixed` / `sps_unfused_mixed` (bench.py's
+      fused_mixed stage headline);
+    - the observatory's before/after on `rx._jit_stream_decode` at
+      the suite-shared geometry: compiled `bytes_accessed` unfused vs
+      fused, asserted STRICTLY lower fused (the roofline claim — the
+      LLR round-trip leaves the program, the constant bank it buys is
+      smaller).
+
+    Returns a flat dict (bench.py stores it verbatim)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ziria_tpu.phy.wifi import rx, tx
+    from ziria_tpu.phy.wifi.params import (RATE_MBPS_ORDER, RATES,
+                                           n_symbols)
+    from ziria_tpu.utils import programs
+
+    rng = np.random.default_rng(33)
+    mbps = (list(RATE_MBPS_ORDER) * (-(-B // 8)))[:B]
+    n_sym_b = rx._sym_bucket(max(n_symbols(n_bytes, RATES[m])
+                                 for m in mbps))
+    need = rx.FRAME_DATA_START + 80 * n_sym_b
+    frames = np.zeros((B, need, 2), np.float32)
+    for i, m in enumerate(mbps):
+        psdu = rng.integers(0, 256, n_bytes).astype(np.uint8)
+        s = np.asarray(tx.encode_frame(psdu, m))
+        ln = min(len(s), need)
+        frames[i, :ln] = s[:ln]
+    frames = jnp.asarray(
+        frames + rng.normal(0, noise_sigma, frames.shape)
+        .astype(np.float32))
+    ridx = jnp.asarray([rx.RATE_INDEX[m] for m in mbps], jnp.int32)
+    nb_host = np.asarray([n_symbols(n_bytes, RATES[m])
+                          * RATES[m].n_dbps for m in mbps], np.int32)
+    nbits = jnp.asarray(nb_host)
+
+    def dec(fused, **kw):
+        return np.asarray(jax.jit(lambda f: rx.decode_data_mixed(
+            f, ridx, nbits, n_sym_b, fused_demap=fused, **kw))(frames))
+
+    base = dec(False)
+    # compare the real prefix per lane: past nbits both paths decode
+    # zero-LLR erasures whose tie-broken bits carry no contract
+    mask = np.arange(base.shape[1])[None, :] < nb_host[:, None]
+    out = {"batch": B, "frame_bytes": n_bytes,
+           "n_sym_bucket": n_sym_b, "noise_sigma": noise_sigma,
+           "rates": sorted(set(mbps))}
+    for name, kw in (("fused_mixed", {}),
+                     ("fused_mixed_radix4", {"viterbi_radix": 4})):
+        got = dec(True, **kw)
+        frac = float((got != base)[mask].mean())
+        out[f"{name}_bit_identical"] = frac == 0.0
+        out[f"{name}_mismatch_frac"] = round(frac, 8)
+        assert frac <= 1e-3, \
+            f"{name} diverged from the unfused mixed decode ({frac:.2e})"
+
+    # marginal mixed-decode step time, fused vs unfused (the
+    # K-spread method of viterbi_kernel_stats)
+    for name, fused in (("unfused_mixed", False),
+                        ("fused_mixed", True)):
+        @jax.jit
+        def loop(x, kk, _f=fused):
+            def body(_i, carry):
+                s, acc = carry
+                bits = rx.decode_data_mixed(x + s, ridx, nbits,
+                                            n_sym_b, fused_demap=_f)
+                s2 = bits[0, 0].astype(jnp.float32) * 1e-30
+                return s2, acc + bits.sum() * 1e-30
+            return jax.lax.fori_loop(
+                0, kk, body, (jnp.float32(0), jnp.float32(0)))[1]
+
+        t_1 = _timed(loop, frames, jnp.int32(k1))
+        t_2 = _timed(loop, frames, jnp.int32(k2))
+        t_step = max((t_2 - t_1) / (k2 - k1), 1e-9)
+        out[f"t_step_{name}_s"] = round(t_step, 6)
+        out[f"sps_{name}"] = round(B * need / t_step, 1)
+    out["fused_over_unfused"] = round(
+        out["t_step_fused_mixed_s"] / out["t_step_unfused_mixed_s"], 3)
+
+    # before/after compiled bytes on THE streaming decode program at
+    # the suite-shared geometry (tests/test_programs.py's pinned
+    # site): the acceptance claim is strictly-lower fused
+    sym_b = rx._sym_bucket(
+        max(1, (frame_len - rx.FRAME_DATA_START) // 80))
+    need_b = rx.FRAME_DATA_START + 80 * sym_b
+    S = jax.ShapeDtypeStruct
+    segs = S((stream_k, need_b, 2), np.float32)
+    row = S((stream_k,), np.int32)
+    for name, fused in (("unfused", False), ("fused", True)):
+        c = programs.cost_of(
+            rx._jit_stream_decode(sym_b, None, None, 2, False, fused),
+            segs, row, row, row, row)
+        out[f"stream_decode_bytes_{name}"] = c.get("bytes_accessed")
+        out[f"stream_decode_flops_{name}"] = c.get("flops")
+    b_un = out["stream_decode_bytes_unfused"]
+    b_fu = out["stream_decode_bytes_fused"]
+    out["stream_decode_bytes_delta"] = round(b_un - b_fu, 1)
+    out["stream_decode_bytes_ratio"] = round(b_fu / b_un, 4)
+    assert b_fu < b_un, \
+        (f"fused stream decode bytes_accessed {b_fu} not below "
+         f"unfused {b_un}")
+    return out
+
+
 def _multi_stream_mesh_main(argv):
     """``rx_dispatch_bench.py --multi-stream-mesh N [S]``: the mesh
     point of `multi_stream_stats` alone, in a process whose caller
@@ -1612,6 +1728,8 @@ def main():
         # program (minutes on CPU, milliseconds of Mosaic on chip)
         out["viterbi_kernel_stats"] = viterbi_kernel_stats(
             B=8, n_bytes=100, k1=2, k2=4, levers=VITERBI_LEVERS[:5])
+        out["fused_mixed"] = fused_mixed_stats(
+            B=8, n_bytes=24, k1=2, k2=4)
         out["mixed_dispatch"] = mixed_dispatch_stats(n_bytes=60)
         out["batched_acquire"] = batched_acquire_stats(n_bytes=60)
         out["link_loopback"] = link_loopback_stats(n_bytes=24)
@@ -1632,6 +1750,7 @@ def main():
         out["quantized"] = quantized_sweep()
         out["viterbi_breakdown"] = viterbi_breakdown()
         out["viterbi_kernel_stats"] = viterbi_kernel_stats()
+        out["fused_mixed"] = fused_mixed_stats()
         out["mixed_dispatch"] = mixed_dispatch_stats()
         out["mixed_dispatch_i16"] = mixed_dispatch_stats(
             viterbi_metric="int16")

@@ -1,0 +1,205 @@
+#!/usr/bin/env python3
+"""Out-of-band watchdogged TPU probe runner (ISSUE 20 satellite).
+
+The axon TPU backend hangs rather than fails (BENCH_PROBES.jsonl
+availability ledger), so any in-process probe risks taking its caller
+down with it. This runner keeps the probe OUT of band: the actual
+backend touch (``bench.py --tpu-probe``: init + one tiny computation)
+runs in a subprocess under a hard kill timeout, the parent never
+imports jax, and every definitive outcome is appended to the same
+BENCH_PROBES.jsonl schema bench.py and tools/tpu_watcher.sh share —
+so the next bench run can trust (or skip re-paying) this answer and
+finally price the PR 6-16 levers on real hardware.
+
+Outcomes and exit codes:
+
+    ok    exit 0   backend initialized and computed on a non-CPU device
+    fail  exit 1   probe exited non-zero, timed out (hang => kill), or
+                   only a CPU device answered (bench exit 3)
+    busy  exit 2   another client holds /tmp/tpu_busy (says nothing
+                   about tunnel health; never cached as a failure)
+
+A cached definitive outcome younger than ``--ttl`` seconds (default
+600, same as bench.py's BENCH_PROBE_NEG_TTL; 0 disables) is returned
+without touching the backend — ``--force`` re-probes regardless. The
+/tmp/tpu_busy mutual-exclusion flag is honored exactly like bench.py:
+``TPU_BUSY_HELD=1`` means the invoker already holds it, a flag older
+than 35 min is treated as leaked and taken over, and this runner's
+own flag is always released. stdout carries exactly one JSON object.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBES_PATH = os.path.join(REPO, "BENCH_PROBES.jsonl")
+BENCH = os.path.join(REPO, "bench.py")
+
+BUSY_FLAG = "/tmp/tpu_busy"
+BUSY_STALE_S = 35 * 60        # same leak threshold as bench.py
+DEFAULT_TIMEOUT = 90.0        # bench.py PROBE_TIMEOUT
+DEFAULT_TTL = 600.0           # bench.py PROBE_NEG_TTL
+
+
+def _record(kind: str, err=None, extra=None) -> dict:
+    """Append one availability-ledger record (bench.py schema: t /
+    probe / unix / src, err on failures). Best-effort append — an
+    unwritable ledger degrades to stdout-only, never a crash."""
+    rec = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "probe": kind, "unix": round(time.time(), 1),
+           "src": "tools/tpu_probe.py"}
+    if err:
+        rec["err"] = err
+    if extra:
+        rec.update(extra)
+    try:
+        with open(PROBES_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    return rec
+
+
+def _cached(ttl: float):
+    """Most recent definitive (ok/fail) ledger outcome within ttl, or
+    None. Scans the whole ledger so out-of-order appends from
+    concurrent writers can't shadow a later outcome; garbage lines
+    and 'busy' records are skipped (busy says nothing about health)."""
+    if ttl <= 0:
+        return None
+    now = time.time()
+    best_t, best = None, None
+    try:
+        with open(PROBES_PATH) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("probe") not in ("ok", "fail"):
+                    continue
+                t = rec.get("unix")
+                if t is None:
+                    try:
+                        import calendar
+                        t = calendar.timegm(time.strptime(
+                            rec.get("t", ""), "%Y-%m-%dT%H:%M:%SZ"))
+                    except (ValueError, TypeError):
+                        continue
+                if t <= now and (best_t is None or t >= best_t):
+                    best_t, best = t, rec
+    except OSError:
+        return None
+    if best is not None and now - best_t < ttl:
+        best = dict(best)
+        best["age_s"] = round(now - best_t, 1)
+        return best
+    return None
+
+
+def _acquire_busy() -> bool:
+    """Take /tmp/tpu_busy (non-blocking — a probe that queues behind a
+    long harvest defeats its own watchdog). Leaked flags older than
+    BUSY_STALE_S are taken over, like bench.py."""
+    if os.environ.get("TPU_BUSY_HELD") == "1":
+        return True
+    for _ in range(2):
+        try:
+            fd = os.open(BUSY_FLAG, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, f"tools/tpu_probe.py pid={os.getpid()}\n".encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            try:
+                age = time.time() - os.path.getmtime(BUSY_FLAG)
+            except OSError:
+                continue          # holder just released; retry create
+            if age <= BUSY_STALE_S:
+                return False
+            print(f"[tpu-probe] stale {BUSY_FLAG} ({age:.0f}s) — "
+                  "taking over", file=sys.stderr, flush=True)
+            try:
+                os.unlink(BUSY_FLAG)
+            except OSError:
+                pass
+    return False
+
+
+def _release_busy() -> None:
+    if os.environ.get("TPU_BUSY_HELD") == "1":
+        return
+    try:
+        with open(BUSY_FLAG) as f:
+            if "tools/tpu_probe.py" not in f.read():
+                return            # not ours
+        os.unlink(BUSY_FLAG)
+    except OSError:
+        pass
+
+
+def _probe_once(timeout: float):
+    """One subprocess probe under a hard kill. Returns (kind, err,
+    extra): kind ok/fail, err text on failure, extra = the child's
+    platform/device_kind JSON on success."""
+    cmd = [sys.executable, BENCH, "--tpu-probe"]
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # subprocess.run kills the child on timeout — the hang dies
+        # with the probe, not with whoever asked for the answer
+        return "fail", f"timeout after {timeout:.0f}s (hang, killed)", None
+    if proc.returncode == 0:
+        extra = None
+        try:
+            extra = json.loads(proc.stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            pass
+        return "ok", None, extra
+    if proc.returncode == 3:
+        return "fail", "no accelerator (CPU-only backend, rc=3)", None
+    tail = (proc.stderr or "").strip().splitlines()[-2:]
+    return "fail", f"rc={proc.returncode}: " + " | ".join(tail), None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=float, default=DEFAULT_TIMEOUT,
+                    help="hard kill timeout for the subprocess probe "
+                         f"(default {DEFAULT_TIMEOUT:.0f}s)")
+    ap.add_argument("--ttl", type=float, default=DEFAULT_TTL,
+                    help="trust a ledger outcome younger than this "
+                         f"(default {DEFAULT_TTL:.0f}s; 0 disables)")
+    ap.add_argument("--force", action="store_true",
+                    help="probe even if a fresh ledger outcome exists")
+    args = ap.parse_args(argv)
+
+    if not args.force:
+        hit = _cached(args.ttl)
+        if hit is not None:
+            hit["cached"] = True
+            print(json.dumps(hit), flush=True)
+            return 0 if hit["probe"] == "ok" else 1
+
+    if not _acquire_busy():
+        rec = _record("busy")
+        print(json.dumps(rec), flush=True)
+        return 2
+    try:
+        kind, err, extra = _probe_once(args.timeout)
+    finally:
+        _release_busy()
+    rec = _record(kind, err=err, extra=extra)
+    print(json.dumps(rec), flush=True)
+    return 0 if kind == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -213,7 +213,8 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
                  viterbi_metric: str = None,
                  viterbi_radix: int = None,
                  batched_acquire: Optional[bool] = None,
-                 sco_track: Optional[bool] = None) -> List[Any]:
+                 sco_track: Optional[bool] = None,
+                 fused_demap: Optional[bool] = None) -> List[Any]:
     """Frame-batched library receiver: N independent captures -> N
     :class:`rx.RxResult`s in O(1) device dispatches — acquire ->
     gather -> mixed-rate decode:
@@ -239,9 +240,11 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
     compiles O(log N) batch variants.
 
     ``viterbi_radix=4`` runs the mixed decode's Pallas ACS two trellis
-    steps per iteration (bit-identical); the fused-demap front end
-    does not apply to the mixed decode (rate-static tables — see
-    rx.decode_data_mixed), so there is no knob for it here.
+    steps per iteration (bit-identical); ``fused_demap=True`` (env
+    ``ZIRIA_FUSED_DEMAP``) runs the rate-SWITCHED fused front end —
+    the stacked 8-rate constant bank row-selected in-kernel, LLRs
+    never leaving VMEM (rx.viterbi_decode_mixed_fused) — on the same
+    one-dispatch mixed decode, bit-identical lane for lane.
     """
     import jax.numpy as jnp
 
@@ -249,6 +252,7 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
 
     batched_acquire = batched_acquire_enabled(batched_acquire)
     sco_track = _rx.sco_track_enabled(sco_track)
+    fused_demap = _rx.fused_demap_enabled(fused_demap)
 
     results: List[Any] = [None] * len(captures)
     if batched_acquire:
@@ -277,13 +281,14 @@ def receive_many(captures: Sequence[Any], check_fcs: bool = False,
                           for _i, a in padded])
     return _mixed_decode_tail(acqs, padded, segs, n_sym_b, results,
                               check_fcs, viterbi_window, viterbi_metric,
-                              viterbi_radix, sco_track)
+                              viterbi_radix, sco_track, fused_demap)
 
 
 def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
                        results: List[Any], check_fcs: bool,
                        viterbi_window, viterbi_metric,
-                       viterbi_radix=None, sco_track: bool = False):
+                       viterbi_radix=None, sco_track: bool = False,
+                       fused_demap: bool = False):
     """The shared tail of every batched receive surface: ONE
     mixed-rate decode dispatch over the lane-padded segments, plus —
     when FCS checking is on — ONE vmapped masked-CRC dispatch at the
@@ -311,7 +316,7 @@ def _mixed_decode_tail(acqs, padded, segs, n_sym_b: int,
     dec = _rx._jit_decode_data_mixed(n_sym_b, viterbi_window,
                                      viterbi_metric,
                                      _check_radix(viterbi_radix),
-                                     sco_track)
+                                     sco_track, fused_demap)
     programs.note_site("rx.decode_mixed", dec, segs, ridx, nbits)
     with dispatch.timed("rx.decode_mixed"):
         clear_dev = dec(segs, ridx, nbits)
@@ -340,7 +345,8 @@ def receive_many_device(x_dev, n_lanes: int, check_fcs: bool = False,
                         viterbi_window: int = None,
                         viterbi_metric: str = None,
                         viterbi_radix: int = None,
-                        sco_track: Optional[bool] = None) -> List[Any]:
+                        sco_track: Optional[bool] = None,
+                        fused_demap: Optional[bool] = None) -> List[Any]:
     """Batched receive over an ALREADY device-resident capture batch —
     the RX side of the loopback link (phy/link.py): the channel's
     output feeds acquisition without the samples ever crossing the
@@ -373,7 +379,8 @@ def receive_many_device(x_dev, n_lanes: int, check_fcs: bool = False,
     return _mixed_decode_tail(lanes, padded, segs, n_sym_b, results,
                               check_fcs, viterbi_window, viterbi_metric,
                               viterbi_radix,
-                              _rx.sco_track_enabled(sco_track))
+                              _rx.sco_track_enabled(sco_track),
+                              _rx.fused_demap_enabled(fused_demap))
 
 
 # ------------------------------------------------------ streaming receiver
@@ -536,7 +543,7 @@ class _LaneHealth:
 
 #: geometry keys that postdate shipped checkpoint blobs, mapped to
 #: the behavior the pre-key code had (see _validate_checkpoint)
-_LEGACY_GEOMETRY_DEFAULTS = {"sco_track": False}
+_LEGACY_GEOMETRY_DEFAULTS = {"sco_track": False, "fused_demap": False}
 
 
 def _validate_checkpoint(st, mine: dict) -> None:
@@ -585,7 +592,8 @@ def _stream_geometry(r) -> dict:
             "viterbi_window": r.viterbi_window,
             "viterbi_metric": r.viterbi_metric,
             "viterbi_radix": r.viterbi_radix,
-            "sco_track": bool(r.sco_track)}
+            "sco_track": bool(r.sco_track),
+            "fused_demap": bool(r.fused_demap)}
 
 
 def _pull_chunk(outs):
@@ -711,6 +719,7 @@ class StreamReceiver:
                  blowup_limit: int = 2, rejoin_after: int = 3,
                  checkpoint: Optional[bytes] = None,
                  sco_track: Optional[bool] = None,
+                 fused_demap: Optional[bool] = None,
                  geometry: Optional[_geometry.Geometry] = None):
         from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
@@ -737,6 +746,8 @@ class StreamReceiver:
         viterbi_radix = (geo.viterbi_radix if viterbi_radix is None
                          else viterbi_radix)
         sco_track = geo.sco_track if sco_track is None else sco_track
+        fused_demap = (geo.fused_demap if fused_demap is None
+                       else fused_demap)
 
         if frame_len != geo.capture_bucket(frame_len):
             raise ValueError(
@@ -760,12 +771,14 @@ class StreamReceiver:
         self.check_fcs = check_fcs
         self.viterbi_window = viterbi_window
         self.viterbi_metric = viterbi_metric
-        # resolved ONCE at construction: the radix and sco_track are
-        # part of the stream's fixed compiled geometry (decode jit
-        # cache key AND the checkpoint fingerprint — a different
-        # decode program emits different bits)
+        # resolved ONCE at construction: the radix, sco_track, and
+        # fused_demap are part of the stream's fixed compiled
+        # geometry (decode jit cache key AND the checkpoint
+        # fingerprint — a different decode program emits different
+        # bits)
         self.viterbi_radix = _check_radix(viterbi_radix)
         self.sco_track = _rx.sco_track_enabled(sco_track)
+        self.fused_demap = _rx.fused_demap_enabled(fused_demap)
         self.streaming = streaming_rx_enabled(streaming)
         # detector params kept for the degraded eager twin (the same
         # chunk graph run op-by-op when the compiled program fails)
@@ -1062,7 +1075,8 @@ class StreamReceiver:
                                          self.viterbi_window,
                                          self.viterbi_metric,
                                          self.viterbi_radix,
-                                         self.sco_track)
+                                         self.sco_track,
+                                         self.fused_demap)
             programs.note_site("rx.stream_decode", dec, segs, rows,
                                ridx, nbits, npsdu)
             got = _guarded_decode(
@@ -1181,6 +1195,7 @@ def receive_stream(samples, chunk_len: Optional[int] = None,
                    viterbi_radix: int = None,
                    streaming: Optional[bool] = None,
                    sco_track: Optional[bool] = None,
+                   fused_demap: Optional[bool] = None,
                    geometry: Optional[_geometry.Geometry] = None):
     """Decode every frame of a long multi-frame sample stream in
     O(chunks) device dispatches (<= 2 per chunk; 1 for all-noise
@@ -1208,7 +1223,7 @@ def receive_stream(samples, chunk_len: Optional[int] = None,
                         viterbi_metric=viterbi_metric,
                         viterbi_radix=viterbi_radix,
                         streaming=streaming, sco_track=sco_track,
-                        geometry=geometry)
+                        fused_demap=fused_demap, geometry=geometry)
     frames = sr.push(samples)
     frames += sr.flush()
     return frames, sr.stats
@@ -1302,6 +1317,7 @@ class MultiStreamReceiver:
                  watchdog_s: Optional[float] = None,
                  blowup_limit: int = 2, rejoin_after: int = 3,
                  sco_track: Optional[bool] = None,
+                 fused_demap: Optional[bool] = None,
                  geometry: Optional[_geometry.Geometry] = None):
         from ziria_tpu.ops.viterbi import _check_radix
         from ziria_tpu.phy.wifi import rx as _rx
@@ -1327,6 +1343,8 @@ class MultiStreamReceiver:
         viterbi_radix = (geo.viterbi_radix if viterbi_radix is None
                          else viterbi_radix)
         sco_track = geo.sco_track if sco_track is None else sco_track
+        fused_demap = (geo.fused_demap if fused_demap is None
+                       else fused_demap)
 
         if n_streams < 1:
             raise ValueError(f"n_streams {n_streams} must be >= 1")
@@ -1357,6 +1375,7 @@ class MultiStreamReceiver:
         self.viterbi_metric = viterbi_metric
         self.viterbi_radix = _check_radix(viterbi_radix)
         self.sco_track = _rx.sco_track_enabled(sco_track)
+        self.fused_demap = _rx.fused_demap_enabled(fused_demap)
         self.mesh = mesh
         self.axis = axis
         self._threshold = float(threshold)
@@ -1854,7 +1873,8 @@ class MultiStreamReceiver:
             dec = _rx._jit_stream_decode_multi(
                 self.n_sym_bucket, self.viterbi_window,
                 self.viterbi_metric, self.viterbi_radix,
-                self.mesh, self.axis, self.sco_track)
+                self.mesh, self.axis, self.sco_track,
+                self.fused_demap)
             dec_args = (segs, self._put(rows), self._put(ridx),
                         self._put(nbits), self._put(npsdu))
             programs.note_site("rx.stream_decode_multi", dec, *dec_args)
@@ -1963,6 +1983,7 @@ def receive_streams(streams, chunk_len: Optional[int] = None,
                     multi: Optional[bool] = None, mesh=None,
                     axis: str = "dp",
                     sco_track: Optional[bool] = None,
+                    fused_demap: Optional[bool] = None,
                     geometry: Optional[_geometry.Geometry] = None):
     """Decode S concurrent multi-frame I/Q streams in O(chunk-steps)
     device dispatches — <= 2 per chunk-step *independent of S*.
@@ -1989,7 +2010,7 @@ def receive_streams(streams, chunk_len: Optional[int] = None,
               viterbi_window=viterbi_window,
               viterbi_metric=viterbi_metric,
               viterbi_radix=viterbi_radix, sco_track=sco_track,
-              geometry=geometry)
+              fused_demap=fused_demap, geometry=geometry)
     if not multi_stream_enabled(multi):
         if mesh is not None:
             # a sharded-vs-oracle comparison must never silently

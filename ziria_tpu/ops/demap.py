@@ -60,7 +60,12 @@ def demap_bit_layout(n_bpsc: int):
     2: ``2 - ||x| - 4|``), ``amp[b]`` the level-1 constant. The tables
     live HERE, next to :func:`demap`, so the kernel's formulas and the
     XLA demap can never drift — tests pin the fused decode bit-for-bit
-    against the demap()+deinterleave()+depuncture() pipeline."""
+    against the demap()+deinterleave()+depuncture() pipeline. Both
+    fused fronts build from these descriptors: the known-rate
+    `_front_tables` AND the rate-switched `mixed_front_tables` bank
+    (all 8 rates stacked, row-selected in-kernel —
+    tests/test_viterbi_fused_mixed.py pins the bank rows to exactly
+    these layouts, jax-free)."""
     if n_bpsc == 1:
         comp, lev, amp = [0], [0], [0.0]
     elif n_bpsc == 2:

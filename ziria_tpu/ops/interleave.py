@@ -48,7 +48,8 @@ def deinterleave_slots(n_cbps: int, n_bpsc: int):
     """(subcarrier, bit) source of each DEinterleaved soft value — the
     static index view of :func:`deinterleave` the in-kernel fused
     front end (ops/viterbi_pallas) bakes into its one-hot gather
-    tables. Position ``q`` of the per-symbol deinterleaved stream
+    tables — both the known-rate `_front_tables` and the stacked
+    8-rate `mixed_front_tables` bank of the rate-switched decode. Position ``q`` of the per-symbol deinterleaved stream
     reads demapped LLR ``r = deinterleave_perm[q]``, and demap's
     ``(..., 48 * n_bpsc)`` layout puts subcarrier ``r // n_bpsc`` bit
     ``r % n_bpsc`` there. Returns ``(sub, bit)`` int32 arrays of

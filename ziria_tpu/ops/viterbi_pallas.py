@@ -47,7 +47,11 @@ tile (`_make_fused_acs_kernel`), so the DATA LLRs are produced and
 consumed in VMEM and never round-trip HBM between the receiver's
 front-end dispatch and the ACS — the kernel's dominant HBM input stream
 drops from 2 f32 LLRs per trellis step to the raw equalized subcarriers
-(~4-9x smaller at the high rates).
+(~4-9x smaller at the high rates). Its rate-SWITCHED twin
+(`viterbi_decode_mixed_fused`) extends the prologue to the mixed-rate
+decode every fleet surface runs: all 8 rates' slot tables stacked into
+one static constant bank, row-selected per lane in-kernel from the
+traced rate index.
 
 Two kernels either way:
   1. ACS sweep  — grid (batch_tiles, T); streams per-step decision planes
@@ -836,12 +840,12 @@ def viterbi_decode_batch_windowed(llrs, n_bits: int = None,
 # stream (the ACS kernel's dominant HBM input, 8 B per trellis step
 # per lane) never exists in HBM at all.
 #
-# Rate-STATIC tables are also the scope boundary: the mixed-rate
-# lax.switch decode shares ONE rate-agnostic Viterbi across the batch
-# (its whole trick), and per-lane tables would fragment it back per
-# rate — so the fused front end serves the known-rate surfaces
-# (decode_data_batch, decode_data_bucketed/receive) and the mixed
-# surfaces keep the XLA front end (docs/architecture.md).
+# The tables are rate-static — but that is no longer a scope boundary:
+# `viterbi_decode_mixed_fused` (below) stacks all 8 rates' tables into
+# ONE constant bank and row-selects per lane IN-KERNEL from the traced
+# rate index, so the mixed-rate lax.switch decode keeps its one
+# rate-agnostic Viterbi across the batch AND gets the VMEM-resident
+# LLR prologue (docs/architecture.md's decode-roofline section).
 
 
 @lru_cache(maxsize=None)
@@ -1085,3 +1089,310 @@ def viterbi_decode_batch_fused(data, gain, rate, n_bits: int = None,
     if n_bits is not None:
         bits = bits[:, :n_bits]
     return bits
+
+
+# ------------------------------------------- rate-switched fused front end
+#
+# The mixed-rate decode (phy/wifi/rx.decode_data_mixed) runs ONE
+# rate-agnostic Viterbi over a batch whose lanes carry different rates;
+# until ISSUE 20 its front end stayed in XLA because the fused tables
+# above are rate-static. The scheduling fact that un-blocks it: every
+# 802.11a n_dbps (24, 36, 48, 72, 96, 144, 192, 216) is a multiple of
+# 12, so any 12-trellis-step window starting at a multiple of 12 lies
+# inside exactly ONE OFDM symbol at EVERY rate, covering a 24-slot
+# stretch of that rate's depunctured stream that starts at a multiple
+# of 24. Chop each rate's (2*n_dbps, ...) slot tables into
+# n_dbps/12 <= 18 chunks of 24 rows, stack them as one
+# (8, 18, 24, ...) constant bank, and a kernel block of 72 steps
+# (6 sub-blocks; 72 divides every bucket's n_sym_bucket * 216 trellis)
+# needs only LEADING-dim indexing — static rate row, traced chunk
+# index — to fetch the right 24 rows: the banks stay static to Mosaic
+# and there is no per-lane gather. Per sub-block the kernel computes
+# all 8 rates' LLRs and lane-selects with the traced rate index — the
+# SAME 8-way compute-then-select the vmapped lax.switch lowers to, so
+# nothing is wasted relative to the unfused graph, while the LLRs (the
+# ACS kernel's dominant HBM input) and the 8-way-redundant XLA front
+# end both disappear from HBM: the fused graph runs ONE rate-
+# independent `rx._front_symbols` per lane instead of 8 per-rate
+# branches.
+#
+# Gains ride the SAME one-hot: sel_x rows pick component 2*c + comp of
+# the flattened symbol, and a (96, LANES) gain plane with row
+# 2*c + u = gain[c] makes `sel_x @ gain2` the exact |H|^2 gather — no
+# separate gain bank, keeping the constant-bank bytes (~1.4 MB) below
+# the LLR bytes they remove.
+
+#: trellis steps per mixed-fused sub-block: gcd of all 8 rates' n_dbps
+MIXED_SUB = 12
+#: trellis steps per mixed-fused grid block (6 sub-blocks; divides
+#: n_sym_bucket * MAX_DBPS for every bucket since 72 | 216)
+MIXED_UNROLL = 72
+#: chunks per rate row in the stacked bank: max n_dbps / MIXED_SUB
+MIXED_CHUNKS = 18
+
+
+@lru_cache(maxsize=None)
+def _mixed_rate_geometry():
+    """(n_dbps, norm) per rate in RATE_MBPS_ORDER — the static per-rate
+    constants the mixed-fused kernel unrolls over. Imported lazily so
+    ops/ keeps no import-time dependency on phy/."""
+    from ziria_tpu.ops.demap import _NORM
+    from ziria_tpu.phy.wifi.params import RATE_MBPS_ORDER, RATES
+    ndbps = tuple(RATES[m].n_dbps for m in RATE_MBPS_ORDER)
+    norms = tuple(float(_NORM[RATES[m].n_bpsc]) for m in RATE_MBPS_ORDER)
+    return ndbps, norms
+
+
+@lru_cache(maxsize=None)
+def mixed_front_tables():
+    """The stacked all-rates slot-table bank of the rate-switched fused
+    front end: ``bank_x`` (8, 18, 24, 96) and ``bank_l`` (8, 18, 24, 8)
+    float32, where row r is rate RATE_MBPS_ORDER[r] and chunk c holds
+    depunctured slot rows [24c, 24c + 24) of that rate's `_front_tables`
+    (chunks at/after n_dbps[r]/12 stay zero — they are never selected).
+    Row-selecting (r, c) reproduces the per-rate tables
+    `demap.demap_bit_layout` / `interleave.deinterleave_slots` /
+    `coding.PUNCTURE_KEEP` emit today, which is the jax-free pin in
+    tests/test_viterbi_fused_mixed.py. Numpy only — no trace, no
+    compile."""
+    from ziria_tpu.phy.wifi.params import RATE_MBPS_ORDER, RATES
+    ndbps, _norms = _mixed_rate_geometry()
+    bank_x = np.zeros((8, MIXED_CHUNKS, 2 * MIXED_SUB, 96), np.float32)
+    bank_l = np.zeros((8, MIXED_CHUNKS, 2 * MIXED_SUB, 8), np.float32)
+    for r, m in enumerate(RATE_MBPS_ORDER):
+        rate = RATES[m]
+        sel_x, _sel_g, lcols = _front_tables(rate.n_bpsc, rate.n_cbps,
+                                             rate.n_dbps, rate.coding)
+        for c in range(ndbps[r] // MIXED_SUB):
+            rows = slice(2 * MIXED_SUB * c, 2 * MIXED_SUB * (c + 1))
+            bank_x[r, c] = sel_x[rows]
+            bank_l[r, c] = lcols[rows]
+    return bank_x, bank_l
+
+
+@lru_cache(maxsize=None)
+def _make_mixed_fused_acs_kernel(n_sym_p: int, radix: int):
+    """Rate-switched fused front-end + ACS kernel (f32 metrics): each
+    grid block covers MIXED_UNROLL trellis steps of the bucket-maximal
+    mixed trellis. Per 12-step sub-block and per rate (a STATIC 8-way
+    unroll — the same 8-way compute the vmapped lax.switch lowers to),
+    the symbol index and bank chunk are computed from the traced block
+    position, the 24-slot tables fetched by leading-dim indexing, the
+    demap expression evaluated in VMEM, and the lanes running that rate
+    selected with `where` on the traced rate-index row. Slots at/after
+    a lane's true bit count become exact 0.0 erasures (the mask
+    decode_data_mixed applies), which also covers the clamped
+    symbol-index reads past a low-rate lane's bucket."""
+    ndbps, norms = _mixed_rate_geometry()
+    nsub = MIXED_UNROLL // MIXED_SUB
+    T2 = 2 * MIXED_SUB
+
+    def kernel(sym_ref, gain_ref, nbits_ref, ridx_ref, *refs):
+        bx_refs = refs[:8]                 # per-rate (cyc_r, 24, 96)
+        bl_refs = refs[8:16]               # per-rate (cyc_r, 24, 8)
+        dec_ref, metrics_out_ref, m_ref = refs[16:]
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _init():
+            rows = jax.lax.broadcasted_iota(jnp.int32,
+                                            (N_STATES, LANES), 0)
+            m_ref[:] = jnp.where(rows == 0, 0.0, _NEG).astype(jnp.float32)
+
+        pack = _pack_sel()
+        if radix == 2:
+            coeffs = _branch_coeffs()
+        else:
+            step1, step2 = _branch_coeffs_r4()
+        nb_row = nbits_ref[0, 0:1, :]                  # (1, 128)
+        r_row = ridx_ref[0, 0:1, :]                    # (1, 128) int32
+        srow = jax.lax.broadcasted_iota(jnp.int32, (T2, LANES), 0) >> 1
+        gain = gain_ref[0]                             # (48, 128)
+        # (96, 128) plane with row 2c+u = gain[c]: sel_x @ gain2 is
+        # then the exact per-slot |H|^2 gather (one-hot rows sum a
+        # single value * 1.0) — no separate gain bank needed
+        gain2 = jnp.concatenate([gain[:, None, :], gain[:, None, :]],
+                                axis=1).reshape(96, LANES)
+
+        # the sub-block walk is a lax.fori_loop, not a python unroll:
+        # the lowered (and analytically costed) loop body is ONE
+        # 12-step sub-block — 8 per-rate table reads + 16 small MXU
+        # dots + 12 ACS steps — instead of MIXED_UNROLL steps of
+        # straight-line code. Decision planes go straight to dec_ref
+        # at a traced leading-dim offset (supported store form).
+        def _sub_block(j, m):
+            s0 = (t * nsub + j) * MIXED_SUB            # traced scalar
+            llr = jnp.zeros((T2, LANES), jnp.float32)
+            for r in range(8):
+                ndb = ndbps[r]
+                # this sub-block's symbol at rate r, clamped into the
+                # resident tile: a low-rate lane's trellis ends at
+                # n_sym_p * ndb < s0 for the clamped region, so every
+                # clamped read feeds only nbits-masked erasure steps
+                k_r = jnp.minimum(s0 // ndb, n_sym_p - 1)
+                c_r = (s0 % ndb) // MIXED_SUB          # bank chunk
+                selx = bx_refs[r][c_r]                 # (24, 96)
+                lc = bl_refs[r][c_r]                   # (24, 8)
+                x = jax.lax.dot(selx, sym_ref[0, k_r], precision=_HI)
+                g = jax.lax.dot(selx, gain2, precision=_HI)
+                xs = x * norms[r]
+                ax = jnp.abs(xs)
+                f = (lc[:, 0:1] * xs + lc[:, 1:2] * (lc[:, 3:4] - ax)
+                     + lc[:, 2:3] * (2.0 - jnp.abs(ax - 4.0)))
+                # where, not multiply: the vmapped switch also computes
+                # every branch and SELECTS — NaN/Inf in a non-selected
+                # rate's arithmetic must not leak across lanes
+                llr = jnp.where(r_row == r, f * g * lc[:, 4:5], llr)
+            llr = jnp.where(s0 + srow < nb_row, llr, 0.0)
+            base = j * MIXED_SUB
+            if radix == 2:
+                for jj in range(MIXED_SUB):
+                    la = llr[2 * jj:2 * jj + 1, :]
+                    lb = llr[2 * jj + 1:2 * jj + 2, :]
+                    m, packed = _acs_step_f32(m, la, lb, coeffs, pack)
+                    dec_ref[0, base + jj] = packed
+            else:
+                for jj in range(MIXED_SUB // 2):
+                    la1 = llr[4 * jj:4 * jj + 1, :]
+                    lb1 = llr[4 * jj + 1:4 * jj + 2, :]
+                    la2 = llr[4 * jj + 2:4 * jj + 3, :]
+                    lb2 = llr[4 * jj + 3:4 * jj + 4, :]
+                    m, pk1, pk2 = _acs_pair_r4_f32(
+                        m, la1, lb1, la2, lb2, step1, step2, pack)
+                    dec_ref[0, base + 2 * jj] = pk1
+                    dec_ref[0, base + 2 * jj + 1] = pk2
+            return m
+
+        m = jax.lax.fori_loop(0, nsub, _sub_block, m_ref[:])
+        m = m - jnp.max(m, axis=0, keepdims=True)
+        m_ref[:] = m
+
+        @pl.when(t == pl.num_programs(1) - 1)
+        def _flush():
+            metrics_out_ref[0] = m_ref[:]
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_sym_p", "t_max", "radix",
+                                    "interpret"))
+def _mixed_fused_decode_tiles(x, g, nbits, ridx, bx, bl, n_sym_p: int,
+                              t_max: int, radix: int, interpret: bool):
+    """Rate-switched fused decode over lane tiles: whole-resident
+    symbol tiles (nb, n_sym_p, 96, 128) + gain (nb, 48, 128) + per-lane
+    bit-count/rate-index rows + the stacked table bank ->
+    (nb, t_max, 128) decoded bit planes.
+
+    The stacked (8, MIXED_CHUNKS, ...) bank enters the kernel as 8
+    per-rate operands trimmed to each rate's real chunk count
+    (n_dbps/12): the in-kernel chunk read then dynamic-slices one
+    small per-rate table, never the whole bank — rate r's row is a
+    trace-time static slice, so nothing is gathered at runtime."""
+    nb = x.shape[0]
+    NB = t_max // MIXED_UNROLL
+    ndbps, _norms = _mixed_rate_geometry()
+    cyc = [n // MIXED_SUB for n in ndbps]
+    bxr = [bx[r, :cyc[r]] for r in range(8)]
+    blr = [bl[r, :cyc[r]] for r in range(8)]
+    bank_specs = (
+        [pl.BlockSpec((cyc[r], 2 * MIXED_SUB, 96),
+                      lambda b, t: (0, 0, 0)) for r in range(8)]
+        + [pl.BlockSpec((cyc[r], 2 * MIXED_SUB, 8),
+                        lambda b, t: (0, 0, 0)) for r in range(8)])
+    dec, metrics = pl.pallas_call(
+        _make_mixed_fused_acs_kernel(n_sym_p, radix),
+        grid=(nb, NB),
+        in_specs=[
+            pl.BlockSpec((1, n_sym_p, 96, LANES),
+                         lambda b, t: (b, 0, 0, 0)),
+            pl.BlockSpec((1, 48, LANES), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, 8, LANES), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, 8, LANES), lambda b, t: (b, 0, 0)),
+        ] + bank_specs,
+        out_specs=[
+            pl.BlockSpec((1, MIXED_UNROLL, 8, LANES),
+                         lambda b, t: (b, t, 0, 0)),
+            pl.BlockSpec((1, N_STATES, LANES), lambda b, t: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb, t_max, 8, LANES), jnp.uint8),
+            jax.ShapeDtypeStruct((nb, N_STATES, LANES), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((N_STATES, LANES), jnp.float32)],
+        interpret=interpret,
+    )(x, g, nbits, ridx, *bxr, *blr)
+
+    bits = pl.pallas_call(
+        _make_traceback_kernel(MIXED_UNROLL),
+        grid=(nb, NB),
+        in_specs=[
+            pl.BlockSpec((1, MIXED_UNROLL, 8, LANES),
+                         lambda b, t, _n=NB: (b, _n - 1 - t, 0, 0)),
+            pl.BlockSpec((1, N_STATES, LANES), lambda b, t: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, MIXED_UNROLL, 8, LANES),
+                               lambda b, t, _n=NB: (b, _n - 1 - t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, t_max, 8, LANES), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((8, LANES), jnp.int32)],
+        interpret=interpret,
+    )(dec, metrics)
+    return bits[:, :, 0, :].astype(jnp.uint8)
+
+
+def viterbi_decode_mixed_fused(data, gain, rate_idx, nbits_real,
+                               radix: int = None,
+                               interpret: bool = None):
+    """Rate-SWITCHED fused-front-end batch decode: equalized,
+    pilot-tracked DATA subcarriers of a mixed-rate batch -> decoded
+    bits over the bucket-maximal trellis, with demap + deinterleave +
+    depuncture executed as an in-kernel prologue that row-selects each
+    lane's slot tables from the stacked all-rates bank — the LLRs live
+    and die in VMEM on the path every fleet surface actually runs.
+
+    data: (B, n_sym_bucket, 48, 2) equalized data-subcarrier pairs
+    (rx._front_symbols under ONE rate-independent vmap — the fused
+    graph's whole XLA front end, vs 8 per-rate branches unfused);
+    gain: (B, 48) |H|^2 weights; rate_idx: (B,) traced indices into
+    RATE_MBPS_ORDER; nbits_real: (B,) traced true data-bit counts.
+    Returns (B, n_sym_bucket * MAX_DBPS) raw decoded bits — the same
+    shape/semantics as the unfused mixed trellis, so the descramble
+    tail is shared.
+
+    float32 metrics only, radix 2 or 4 (the quantized paths scale by
+    the whole frame's LLR peak the prologue never materializes;
+    decode_data_mixed falls back to the unfused front for them).
+    Bit-identity contract vs the unfused mixed decode matches the
+    known-rate fused path's: expression-identical demap arithmetic and
+    the identical erasure mask, renorm cadence MIXED_UNROLL instead of
+    UNROLL (pinned lane-for-lane at the test seeds across all 8 rates;
+    tests/test_viterbi_fused_mixed.py)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    radix = _check_radix(radix)
+    ndbps, _norms = _mixed_rate_geometry()
+    data = jnp.asarray(data, jnp.float32)
+    gain = jnp.asarray(gain, jnp.float32)
+    B, n_sym_b = data.shape[0], data.shape[1]
+    t_max = n_sym_b * max(ndbps)
+    Bp = -(-B // LANES) * LANES
+    nb_tiles = Bp // LANES
+    x = data.reshape(B, n_sym_b, 96)          # (48, I/Q) -> 2c + comp
+    x = jnp.pad(x, ((0, Bp - B), (0, 0), (0, 0)))
+    x = x.transpose(1, 2, 0).reshape(n_sym_b, 96, nb_tiles, LANES) \
+         .transpose(2, 0, 1, 3)
+    g = jnp.pad(gain, ((0, Bp - B), (0, 0)))
+    g = g.transpose(1, 0).reshape(48, nb_tiles, LANES).transpose(1, 0, 2)
+
+    def _rows(v):
+        # pad lanes ride rate 0 / nbits 0: every step masks to an
+        # erasure, the unfused path's zero-LLR pad-lane semantics
+        vp = jnp.pad(jnp.broadcast_to(jnp.asarray(v, jnp.int32), (B,)),
+                     (0, Bp - B)).reshape(nb_tiles, 1, LANES)
+        return jnp.broadcast_to(vp, (nb_tiles, 8, LANES))
+
+    bank_x, bank_l = mixed_front_tables()
+    bits = _mixed_fused_decode_tiles(
+        x, g, _rows(nbits_real), _rows(rate_idx), jnp.asarray(bank_x),
+        jnp.asarray(bank_l), n_sym_b, t_max, radix, interpret)
+    return bits.transpose(0, 2, 1).reshape(Bp, -1)[:B]

@@ -181,6 +181,7 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
                   viterbi_radix: int = None,
                   channel_profile=None,
                   sco_track: Optional[bool] = None,
+                  fused_demap: Optional[bool] = None,
                   geometry=None) -> List:
     """The full N-frame mixed-rate loopback. Default: the FUSED path —
     encode → per-lane channel impairments → acquire → classify →
@@ -228,12 +229,15 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
                          if viterbi_radix is None else viterbi_radix)
         sco_track = (geometry.sco_track
                      if sco_track is None else sco_track)
+        fused_demap = (geometry.fused_demap
+                       if fused_demap is None else fused_demap)
     # resolved ONCE here so the per-frame oracle, the staged path, and
     # the fused graph's compile-cache key all see the same radix,
-    # per-lane profile names, and sco_track value
+    # per-lane profile names, sco_track, and fused_demap values
     viterbi_radix = _check_radix(viterbi_radix)
     prof_key = chanprof.resolve_profiles(channel_profile, n)
     sco_track = rx.sco_track_enabled(sco_track)
+    fused_demap = rx.fused_demap_enabled(fused_demap)
     # profiled links reserve FIR-ring headroom in the capture bucket
     # (max taps - 1; zero for flat/None, so those buckets — and their
     # noise-draw geometry — are byte-for-byte today's)
@@ -258,6 +262,7 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
                                       viterbi_window=viterbi_window,
                                       viterbi_metric=viterbi_metric,
                                       viterbi_radix=viterbi_radix,
+                                      fused_demap=fused_demap,
                                       sco_track=sco_track))
         return results
 
@@ -270,16 +275,18 @@ def loopback_many(psdus, rates_mbps: Sequence[int],
     if fused_link_enabled(fused):
         return _loopback_fused(geo, seed, check_fcs,
                                viterbi_window, viterbi_metric,
-                               viterbi_radix, prof_rows, sco_track)
+                               viterbi_radix, prof_rows, sco_track,
+                               fused_demap)
     return _loopback_staged(geo, seed, check_fcs, viterbi_window,
                             viterbi_metric, viterbi_radix, prof_rows,
-                            sco_track)
+                            sco_track, fused_demap)
 
 
 def _loopback_staged(geo: _LinkGeometry, seed, check_fcs,
                      viterbi_window, viterbi_metric,
                      viterbi_radix=None, prof_rows=None,
-                     sco_track: bool = False) -> List:
+                     sco_track: bool = False,
+                     fused_demap: bool = False) -> List:
     """The staged ~5-dispatch batched loopback (the fused graph's
     bit-identical oracle): one encode_many dispatch, one impair_many
     dispatch, then receive_many_device's acquire → gather → decode
@@ -296,7 +303,8 @@ def _loopback_staged(geo: _LinkGeometry, seed, check_fcs,
     return framebatch.receive_many_device(
         caps, geo.n, check_fcs=check_fcs,
         viterbi_window=viterbi_window, viterbi_metric=viterbi_metric,
-        viterbi_radix=viterbi_radix, sco_track=sco_track)
+        viterbi_radix=viterbi_radix, sco_track=sco_track,
+        fused_demap=fused_demap)
 
 
 @lru_cache(maxsize=None)
@@ -304,7 +312,8 @@ def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
                     l_cap: int, viterbi_window: int = None,
                     viterbi_metric: str = None,
                     viterbi_radix: int = None, profile_key=None,
-                    sco_track: bool = False):
+                    sco_track: bool = False,
+                    fused_demap: bool = False):
     """ONE compiled loopback link per (lane count, bit bucket, symbol
     bucket, capture bucket, decode mode, per-lane channel-profile
     names): the whole TX → channel → RX chain — including the
@@ -354,7 +363,8 @@ def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
         clear = rx.decode_data_mixed(segs, ridx_b, ndata_b, sym_bucket,
                                      viterbi_window, viterbi_metric,
                                      viterbi_radix,
-                                     sco_track=sco_track)
+                                     sco_track=sco_track,
+                                     fused_demap=fused_demap)
         # 7. batched FCS check over the decoded PSDUs
         crc_ok = rx.crc_psdu_many_graph(clear, nbits_b)
         return status, mbps_sig, len_sig, nsym_sig, clear, crc_ok
@@ -365,7 +375,8 @@ def _jit_fused_link(rows: int, bit_bucket: int, sym_bucket: int,
 def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
                     viterbi_window, viterbi_metric,
                     viterbi_radix=None, prof_rows=None,
-                    sco_track: bool = False) -> List:
+                    sco_track: bool = False,
+                    fused_demap: bool = False) -> List:
     """Host wrapper of the fused graph: ONE device dispatch, then the
     per-lane RxResult assembly from the returned validity flags —
     integer reads only, exactly mirroring `_classify_acquire`'s
@@ -379,7 +390,7 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
 
     fn = _jit_fused_link(geo.rows, geo.bit_b, geo.sym_b, geo.l_cap,
                          viterbi_window, viterbi_metric, viterbi_radix,
-                         prof_rows, sco_track)
+                         prof_rows, sco_track, fused_demap)
     fused_args = (
         jnp.asarray(geo.bits_b), jnp.asarray(geo.nbits_b),
         jnp.asarray(geo.ridx_b), jnp.asarray(geo.nv_tx),
@@ -399,7 +410,7 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
         _note_link_degraded("link.fused_degraded")
         return _loopback_staged(geo, seed, check_fcs, viterbi_window,
                                 viterbi_metric, viterbi_radix,
-                                prof_rows, sco_track)
+                                prof_rows, sco_track, fused_demap)
     try:
         # on an async backend a mid-execution runtime failure
         # surfaces HERE at the host pull, after the guarded dispatch
@@ -413,7 +424,7 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
         _note_link_degraded("link.fused_degraded")
         return _loopback_staged(geo, seed, check_fcs, viterbi_window,
                                 viterbi_metric, viterbi_radix,
-                                prof_rows, sco_track)
+                                prof_rows, sco_track, fused_demap)
     # healthy pass: re-record the gauge LEVEL so a past degrade does
     # not latch forever on dashboards (the rx receivers' per-chunk
     # level discipline)
@@ -442,7 +453,7 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
             return _loopback_staged(geo, seed, check_fcs,
                                     viterbi_window, viterbi_metric,
                                     viterbi_radix, prof_rows,
-                                    sco_track)
+                                    sco_track, fused_demap)
         if clear_np is None:
             try:
                 clear_np = np.asarray(clear, np.uint8)
@@ -452,7 +463,7 @@ def _loopback_fused(geo: _LinkGeometry, seed, check_fcs,
                 return _loopback_staged(geo, seed, check_fcs,
                                         viterbi_window, viterbi_metric,
                                         viterbi_radix, prof_rows,
-                                        sco_track)
+                                        sco_track, fused_demap)
         psdu = clear_np[i][N_SERVICE_BITS: N_SERVICE_BITS + 8 * ln]
         crc = bool(crc_np[i]) if check_fcs else None
         results[i] = rx.RxResult(True, m, ln, psdu, crc)

@@ -432,7 +432,8 @@ def decode_data_mixed(frames, rate_idx, n_bits_real, n_sym_bucket: int,
                       viterbi_metric: str = None,
                       viterbi_radix: int = None,
                       interpret: bool = None,
-                      sco_track: bool = False):
+                      sco_track: bool = False,
+                      fused_demap: bool = None):
     """Mixed-rate batched DATA decode in ONE device dispatch — the
     compiled-program analogue of Ziria's in-language rate dispatch
     (the reference's `parsePLCPHeader ; per-rate loop` runs INSIDE the
@@ -465,32 +466,46 @@ def decode_data_mixed(frames, rate_idx, n_bits_real, n_sym_bucket: int,
 
     ``viterbi_radix``/``viterbi_metric`` reach the shared Pallas ACS,
     so every mixed surface (receive_many, the streaming receiver, the
-    fused link) inherits the faster core. The fused-demap front end
-    does NOT apply here by design: its slot tables are rate-static,
-    and per-lane tables would fragment the one rate-agnostic Viterbi
-    this dispatch exists to share — the cheap XLA front end stays.
+    fused link) inherits the faster core. ``fused_demap=True`` moves
+    demap + deinterleave + depuncture into the kernel here too
+    (ISSUE 20): the rate-SWITCHED fused prologue row-selects each
+    lane's slot tables from one stacked all-rates constant bank
+    (ops/viterbi_pallas.viterbi_decode_mixed_fused), the XLA front
+    collapses from 8 per-rate branches to ONE rate-independent
+    `_front_symbols` vmap, and the LLRs are produced and consumed in
+    VMEM — the one rate-agnostic Viterbi this dispatch exists to
+    share stays one kernel. Windowed/quantized modes fall back to the
+    (bit-identical) unfused front, exactly like the known-rate path.
     """
     t_max = n_sym_bucket * MAX_DBPS
-
-    def _branch(rate):
-        def f(frame):
-            dep = _decode_front(frame, rate, n_sym_bucket, sco_track)
-            return jnp.pad(dep, ((0, t_max - dep.shape[0]), (0, 0)))
-        return f
-
-    branches = [_branch(RATES[m]) for m in RATE_MBPS_ORDER]
     rate_idx = jnp.asarray(rate_idx, jnp.int32)
     n_bits_real = jnp.asarray(n_bits_real, jnp.int32)
-    dep = jax.vmap(
-        lambda f, r: jax.lax.switch(r, branches, f))(frames, rate_idx)
-    # rows at/after each lane's true bit count become erasures (covers
-    # both the in-rate bucket pad and the cross-rate pad to MAX_DBPS)
-    t = jnp.arange(t_max)
-    dep = jnp.where((t[None, :] < n_bits_real[:, None])[..., None],
-                    dep, 0.0)
-    bits = viterbi_pallas.viterbi_decode_batch_opt(
-        dep, window=viterbi_window, metric_dtype=viterbi_metric,
-        radix=viterbi_radix, interpret=interpret)
+    if fused_demap_enabled(fused_demap) \
+            and _fused_front_applies(viterbi_window, viterbi_metric):
+        data, gain = jax.vmap(
+            lambda f: _front_symbols(f, n_sym_bucket, sco_track))(frames)
+        bits = viterbi_pallas.viterbi_decode_mixed_fused(
+            data, gain, rate_idx, n_bits_real, radix=viterbi_radix,
+            interpret=interpret)
+    else:
+        def _branch(rate):
+            def f(frame):
+                dep = _decode_front(frame, rate, n_sym_bucket, sco_track)
+                return jnp.pad(dep, ((0, t_max - dep.shape[0]), (0, 0)))
+            return f
+
+        branches = [_branch(RATES[m]) for m in RATE_MBPS_ORDER]
+        dep = jax.vmap(
+            lambda f, r: jax.lax.switch(r, branches, f))(frames, rate_idx)
+        # rows at/after each lane's true bit count become erasures
+        # (covers both the in-rate bucket pad and the cross-rate pad
+        # to MAX_DBPS)
+        t = jnp.arange(t_max)
+        dep = jnp.where((t[None, :] < n_bits_real[:, None])[..., None],
+                        dep, 0.0)
+        bits = viterbi_pallas.viterbi_decode_batch_opt(
+            dep, window=viterbi_window, metric_dtype=viterbi_metric,
+            radix=viterbi_radix, interpret=interpret)
 
     def _descramble(b):
         seed = scramble.recover_seed(b[:7])
@@ -525,17 +540,22 @@ def _jit_crc_many():
 def _jit_decode_data_mixed(n_sym_bucket: int, viterbi_window: int = None,
                            viterbi_metric: str = None,
                            viterbi_radix: int = None,
-                           sco_track: bool = False):
+                           sco_track: bool = False,
+                           fused_demap: bool = False):
     """ONE jit per (symbol bucket, decode mode) serving ALL rates —
-    the decode-mode knobs (window, metric, radix, sco_track) are part
-    of the cache key, so an in-process change can never silently
-    reuse the other mode's trace (ADVICE r5 #1 discipline; callers
-    pass RESOLVED radix/sco values, never None-meaning-env)."""
+    the decode-mode knobs (window, metric, radix, sco_track,
+    fused_demap) are part of the cache key, so an in-process change
+    can never silently reuse the other mode's trace (ADVICE r5 #1
+    discipline; callers pass RESOLVED radix/sco/fused values, never
+    None-meaning-env). ``fused_demap`` stays the LAST parameter —
+    tests/test_lint.py's R1 acceptance demo AST-drops it by
+    position."""
     def f(frames, rate_idx, n_bits_real):
         return decode_data_mixed(frames, rate_idx, n_bits_real,
                                  n_sym_bucket, viterbi_window,
                                  viterbi_metric, viterbi_radix,
-                                 sco_track=sco_track)
+                                 sco_track=sco_track,
+                                 fused_demap=fused_demap)
     return jax.jit(f)
 
 
@@ -1031,19 +1051,22 @@ def _jit_stream_chunk(k: int, win_len: int, n_sym_bucket: int,
 def _jit_stream_decode(n_sym_bucket: int, viterbi_window: int = None,
                        viterbi_metric: str = None,
                        viterbi_radix: int = None,
-                       sco_track: bool = False):
+                       sco_track: bool = False,
+                       fused_demap: bool = False):
     """Dispatch 2 of the streaming chunk: row-select the decodable
     lanes INSIDE the jit (the segment batch never re-crosses the host
     link), the one-`lax.switch` mixed-rate decode at the stream's
     fixed symbol bucket, and the vmapped masked-CRC check. The CRC
     flags are always computed (noise next to the Viterbi), so one
     compile serves both `check_fcs` modes — the fused-link rule. The
-    decode-mode knobs are cache keys (resolved radix, like every jit
-    factory here)."""
+    decode-mode knobs are cache keys (resolved radix/fused values,
+    like every jit factory here); ``fused_demap`` is LAST so the R1
+    lint demo can AST-drop it by position."""
     def f(segs, rows, ridx, nbits, npsdu):
         clear = decode_data_mixed(segs[rows], ridx, nbits, n_sym_bucket,
                                   viterbi_window, viterbi_metric,
-                                  viterbi_radix, sco_track=sco_track)
+                                  viterbi_radix, sco_track=sco_track,
+                                  fused_demap=fused_demap)
         return clear, crc_psdu_many_graph(clear, npsdu)
     return jax.jit(f)
 
@@ -1115,7 +1138,8 @@ def _jit_stream_decode_multi(n_sym_bucket: int, viterbi_window: int = None,
                              viterbi_metric: str = None,
                              viterbi_radix: int = None, mesh=None,
                              axis: str = "dp",
-                             sco_track: bool = False):
+                             sco_track: bool = False,
+                             fused_demap: bool = False):
     """Dispatch 2 of the multi-stream chunk-step: per-stream row-
     select of the decodable lanes (all inside the jit, over the still
     device-resident (S, K, ...) segment batch), then the (S*K)-lane
@@ -1123,15 +1147,17 @@ def _jit_stream_decode_multi(n_sym_bucket: int, viterbi_window: int = None,
     Pallas Viterbi batch for the whole fleet, every lane riding the
     same 128-lane tiles (lane values are batch-independent, the
     pinned receive_many contract, so each lane is bit-identical to
-    its single-stream K-lane decode). Decode-mode knobs and the mesh
-    are cache keys, as in every jit factory here."""
+    its single-stream K-lane decode). Decode-mode knobs (including
+    the resolved ``fused_demap``, LAST for the R1 lint demo) and the
+    mesh are cache keys, as in every jit factory here."""
     def f(segs, rows, ridx, nbits, npsdu):
         sel = jax.vmap(lambda sg, r: sg[r])(segs, rows)
         s, kk = rows.shape
         clear = decode_data_mixed(
             sel.reshape((s * kk,) + sel.shape[2:]), ridx.reshape(-1),
             nbits.reshape(-1), n_sym_bucket, viterbi_window,
-            viterbi_metric, viterbi_radix, sco_track=sco_track)
+            viterbi_metric, viterbi_radix, sco_track=sco_track,
+            fused_demap=fused_demap)
         crc = crc_psdu_many_graph(clear, npsdu.reshape(-1))
         return (clear.reshape(s, kk, -1), crc.reshape(s, kk))
 

@@ -66,7 +66,12 @@ Candidate = Tuple[str, Geometry]
 
 def default_candidates(base: Geometry) -> List[Candidate]:
     """The search neighborhood around ``base`` (which must be
-    resolved): the chunk-length ladder and the radix-4 ACS. Every
+    resolved): the chunk-length ladder, the radix-4 ACS, the fused
+    demap front end (now a MEASURED axis — the rate-switched fused
+    mixed decode covers the streaming surfaces this harness times),
+    and the joint ``chunk_len x fused_demap`` move (the fused
+    decode's VMEM residency shifts the scan/decode balance, so the
+    chunk length that wins unfused need not win fused). Every
     candidate keeps ``frame_len``/detector params fixed — those are
     part of the identity contract's geometry, not throughput
     tunables."""
@@ -77,6 +82,12 @@ def default_candidates(base: Geometry) -> List[Candidate]:
             out.append((f"chunk{cl}", base.replace(chunk_len=cl)))
     if base.viterbi_radix != 4:
         out.append(("radix4", base.replace(viterbi_radix=4)))
+    if not base.fused_demap:
+        out.append(("fused_demap", base.replace(fused_demap=True)))
+        cl2 = base.chunk_len * 2
+        if cl2 > base.frame_len:
+            out.append((f"chunk{cl2}_fused",
+                        base.replace(chunk_len=cl2, fused_demap=True)))
     return out
 
 

@@ -120,7 +120,10 @@ def env_viterbi_radix() -> int:
 def env_fused_demap() -> bool:
     """ZIRIA_FUSED_DEMAP (default OFF — the XLA front end is the
     oracle): run demap+deinterleave+depuncture as an in-kernel
-    prologue of the Pallas ACS."""
+    prologue of the Pallas ACS, on BOTH the known-rate decode
+    (`viterbi_decode_batch_fused`) and the rate-switched mixed decode
+    every streaming/fleet surface runs (`viterbi_decode_mixed_fused`
+    — the stacked 8-rate constant bank, row-selected in-kernel)."""
     return os.environ.get("ZIRIA_FUSED_DEMAP", "0") == "1"
 
 
